@@ -1,0 +1,65 @@
+"""Benchmark: ResNet-50 inference throughput on the local accelerator.
+
+Mirrors the reference's headline benchmark
+(example/image-classification/benchmark_score.py; numbers in
+docs/.../faq/perf.md — V100 fp16 batch 128: 2355.04 img/s, BASELINE.md).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+BASELINE_IMG_S = 2355.04  # V100 fp16, ResNet-50, batch 128 (perf.md:210)
+BATCH = 128
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+    from mxnet_tpu.cached_op import trace
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1()
+    net.initialize()
+    # bf16 everywhere: MXU-native inference precision
+    net.cast("bfloat16")
+    x = mx.np.zeros((BATCH, 3, 224, 224), dtype="bfloat16")
+    params = [(name, p.data())
+              for name, p in net.collect_params().items()
+              if p._data is not None]
+    _, _, cop = trace(lambda a: net(a), [x], params)
+    arrs = [x] + [arr for _, arr in params]
+
+    import numpy as onp
+
+    def sync(arr):
+        # device->host readback: the only reliable barrier on every PJRT
+        # backend (block_until_ready is a no-op on some tunneled platforms)
+        return onp.asarray(arr._data[0, 0])
+
+    for _ in range(WARMUP):
+        out = cop(*arrs)
+        sync(out)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = cop(*arrs)
+    sync(out)
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_bf16_infer_batch128",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Deferred compute: trace imperative execution into a Symbol graph.
+
+TPU-native equivalent of the reference's deferred-compute mode
+(python/mxnet/_deferred_compute.py; C side DCInfo in include/mxnet/imperative.h:94
+and MXNDArraySetIsDeferredCompute, src/c_api/c_api_ndarray.cc:421-450). This is
+how HybridBlock.hybridize captures a graph: the forward runs eagerly (real
+values, real shapes) while every registry.invoke also appends a SymNode. The
+captured Symbol then compiles to ONE XLA program via CachedOp.
+
+Differences from the reference, by design:
+- constants are captured automatically (arrays created inside forward become
+  const nodes) instead of erroring;
+- rng ops mark the trace as rng-dependent; the compiled program takes a fresh
+  key input per call (reference used mutable per-op random resources);
+- aux-state updates (BatchNorm moving stats) are registered as extra graph
+  outputs written back after each call (reference mutated aux NDArrays
+  in-kernel through the engine).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from .base import MXNetError
+from .symbol.symbol import SymNode, Literal
+
+__all__ = ["is_tracing", "context", "set_variable"]
+
+
+class _TraceCtx:
+    def __init__(self):
+        self.uses_rng = False
+        self.aux_updates = []  # [(target NDArray, source entry)]
+        self.marked = []       # arrays whose _dc_sym we set (for cleanup)
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.ctx = None
+
+
+_state = _State()
+
+
+def is_tracing() -> bool:
+    return _state.ctx is not None
+
+
+def current() -> _TraceCtx:
+    if _state.ctx is None:
+        raise MXNetError("no deferred-compute trace is active")
+    return _state.ctx
+
+
+@contextlib.contextmanager
+def context():
+    """Enter tracing mode (reference: _deferred_compute.context)."""
+    if _state.ctx is not None:
+        raise MXNetError("deferred compute traces cannot nest")
+    _state.ctx = _TraceCtx()
+    try:
+        yield _state.ctx
+    finally:
+        for arr in _state.ctx.marked:
+            arr._dc_sym = None
+        _state.ctx = None
+
+
+@contextlib.contextmanager
+def suspend():
+    """Temporarily leave tracing mode (used while evaluating op-internal
+    python, e.g. control-flow bodies that re-enter the op registry)."""
+    prev, _state.ctx = _state.ctx, None
+    try:
+        yield
+    finally:
+        _state.ctx = prev
+
+
+def set_variable(arr, name: str) -> SymNode:
+    """Mark an NDArray as a graph input (reference: dc.set_variable)."""
+    ctx = current()
+    node = SymNode(name=name)
+    arr._dc_sym = (node, 0)
+    ctx.marked.append(arr)
+    return node
+
+
+def register_aux_update(target_arr, source_arr) -> None:
+    """Record 'write source into target after every compiled call' (BN stats)."""
+    ctx = current()
+    if source_arr._dc_sym is None:
+        raise MXNetError("aux update source was not produced by a traced op")
+    ctx.aux_updates.append((target_arr, source_arr._dc_sym))
+
+
+def _record_op(op, attrs, inputs, outputs) -> None:
+    """Append a SymNode for an invoked op. Called from ops.registry.invoke."""
+    from .ndarray.ndarray import NDArray
+
+    ctx = current()
+    entries = []
+    for x in inputs:
+        if isinstance(x, NDArray):
+            if x._dc_sym is None:
+                # constant capture: array not marked as input -> bake value
+                x._dc_sym = (SymNode(value=x._data), 0)
+                ctx.marked.append(x)
+            entries.append(x._dc_sym)
+        else:
+            entries.append(Literal(x))
+    if op.needs_rng:
+        ctx.uses_rng = True
+    node = SymNode(op=op, attrs=attrs, inputs=entries, nout=len(outputs))
+    for i, o in enumerate(outputs):
+        o._dc_sym = (node, i)
+        ctx.marked.append(o)

@@ -1,0 +1,136 @@
+"""AMP — automatic mixed precision (reference: python/mxnet/amp/).
+
+The reference monkey-patches op namespaces with fp16/fp32 cast lists
+(amp/amp.py:308, lists in amp/lists/symbol_fp16.py) and runs an nnvm pass
+(src/nnvm/low_precision_pass.cc). TPU-native design: bfloat16 is the MXU's
+native input type, so AMP is a *cast-at-the-compute-op* policy — when active,
+MXU-bound ops (matmul/conv/FC/attention) run their inputs in bf16 and
+accumulate fp32 (XLA's preferred_element_type), while reductions/norms stay
+fp32. No loss scaling is needed for bf16 (same exponent range as fp32); a
+LossScaler is provided for fp16 parity with the reference API.
+"""
+from __future__ import annotations
+
+import threading
+
+from .loss_scaler import LossScaler, DynamicLossScaler, StaticLossScaler
+
+__all__ = ["init", "is_enabled", "target_dtype", "scale_loss", "unscale",
+           "convert_hybrid_block", "LossScaler", "DynamicLossScaler",
+           "StaticLossScaler", "autocast"]
+
+# ops that benefit from bf16 inputs on the MXU (reference: FP16_FUNCS list)
+MXU_OPS = frozenset({
+    "fully_connected", "convolution", "deconvolution", "matmul", "dot",
+    "batch_dot", "einsum", "multihead_attention", "tensordot",
+})
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = "bfloat16"
+    return _state
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable mixed precision (reference: amp.init, amp/amp.py:308)."""
+    st = _st()
+    st.enabled = True
+    st.dtype = str(target_dtype)
+    return True
+
+
+def disable():
+    _st().enabled = False
+
+
+def is_enabled() -> bool:
+    return _st().enabled
+
+
+def target_dtype() -> str:
+    return _st().dtype
+
+
+class autocast:
+    """Context manager enabling AMP locally."""
+
+    def __init__(self, dtype="bfloat16"):
+        self.dtype = dtype
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.enabled, st.dtype)
+        st.enabled, st.dtype = True, self.dtype
+        return self
+
+    def __exit__(self, *exc):
+        _st().enabled, _st().dtype = self._prev
+
+
+def maybe_cast_inputs(op_name, datas):
+    """Called by the op registry: cast MXU-op operands when AMP is active."""
+    st = _st()
+    if not st.enabled or op_name not in MXU_OPS:
+        return datas
+    import jax.numpy as jnp
+    import numpy as onp
+
+    tgt = jnp.bfloat16 if st.dtype == "bfloat16" else jnp.float16
+    out = []
+    for d in datas:
+        if hasattr(d, "dtype") and d.dtype in (jnp.float32, onp.float32):
+            out.append(d.astype(tgt))
+        else:
+            out.append(d)
+    return out
+
+
+def scale_loss(loss, optimizer_or_trainer):
+    """Reference-parity loss scaling context (no-op for bf16)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        if _st().dtype == "bfloat16":
+            yield loss
+        else:
+            scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+            if scaler is None:
+                scaler = DynamicLossScaler()
+                optimizer_or_trainer._amp_loss_scaler = scaler
+            yield loss * scaler.loss_scale
+
+    return ctx()
+
+
+def unscale(optimizer_or_trainer):
+    scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    for param in optimizer_or_trainer._params:
+        if param.grad_req == "null" or param._data is None:
+            continue
+        g = param.grad()
+        g._set_data(g._data / scaler.loss_scale)
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", ctx=None):
+    """Cast a block's parameters to the low-precision dtype (reference:
+    amp.convert_hybrid_block, amp/amp.py:670). Norm-layer params stay fp32."""
+    keep_fp32 = ("gamma", "beta", "running_mean", "running_var",
+                 "moving_mean", "moving_var")
+    for name, param in block.collect_params().items():
+        if any(name.endswith(s) for s in keep_fp32):
+            continue
+        param.cast(target_dtype)
+    return block
+
+
+def convert_model(*args, **kwargs):
+    raise NotImplementedError("symbolic convert_model: use "
+                              "convert_hybrid_block on the Gluon API")

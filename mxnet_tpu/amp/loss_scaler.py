@@ -1,0 +1,50 @@
+"""Loss scalers for fp16 training (reference: python/mxnet/amp/loss_scaler.py:26).
+
+bf16 does not need scaling (fp32 exponent range); these exist for fp16 parity.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["LossScaler", "StaticLossScaler", "DynamicLossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16):
+        self.loss_scale = init_scale
+
+    def has_overflow(self, params):
+        for p in params:
+            if getattr(p, "grad_req", "write") == "null" or \
+                    getattr(p, "_data", None) is None:
+                continue
+            g = p.grad().asnumpy()
+            if not onp.isfinite(g).all():
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        pass
+
+
+class StaticLossScaler(LossScaler):
+    pass
+
+
+class DynamicLossScaler(LossScaler):
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.0):
+        super().__init__(init_scale)
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self._unskipped = 0
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self.scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self.scale_window:
+                self.loss_scale *= self.scale_factor
+                self._unskipped = 0

@@ -1,0 +1,293 @@
+"""Imperative autograd: tape of per-op VJPs.
+
+TPU-native redesign of the reference autograd (python/mxnet/autograd.py over
+Imperative::RecordOp/Backward, src/imperative/imperative.cc:204,387). The
+reference builds an nnvm graph of FGradient nodes and re-executes it through the
+engine; here each recorded op contributes a ``jax.vjp`` closure (XLA-compiled,
+residuals live in HBM) and ``backward()`` walks the tape in reverse execution
+order accumulating cotangents. Because a hybridized block is recorded as a
+single CachedOp invocation, its whole backward is one transposed XLA program —
+the analog of CachedOp::Backward's full-graph pass (cached_op.cc:1016).
+
+API parity: record/pause/train_mode/predict_mode contexts, is_recording/
+is_training, mark_variables, backward, grad, and the grad_req semantics of
+Parameter ('write'/'add'/'null').
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+
+import jax
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_state = _State()
+_seq = itertools.count()
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _state.recording = _state.recording, bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _state.training = _state.training, bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def _scope(recording=None, training=None):
+    prev_r = set_recording(recording) if recording is not None else None
+    prev_t = set_training(training) if training is not None else None
+    try:
+        yield
+    finally:
+        if recording is not None:
+            set_recording(prev_r)
+        if training is not None:
+            set_training(prev_t)
+
+
+def record(train_mode: bool = True):
+    """``with autograd.record():`` — record ops for later backward."""
+    return _scope(recording=True, training=train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    return _scope(training=True)
+
+
+def predict_mode():
+    return _scope(training=False)
+
+
+# ---------------------------------------------------------------------------
+# tape structures
+# ---------------------------------------------------------------------------
+class AGInfo:
+    """Per-NDArray autograd link (reference: AGInfo, include/mxnet/imperative.h:54).
+
+    Either a *variable* (``variable`` set — gradient sink with a grad buffer)
+    or an *op output* (``node``/``index`` set).
+    """
+
+    __slots__ = ("node", "index", "variable")
+
+    def __init__(self, node=None, index=0, variable=None):
+        self.node = node
+        self.index = index
+        self.variable = variable
+
+
+class _TapeNode:
+    __slots__ = ("vjp", "in_infos", "out_avals", "seq", "multi")
+
+    def __init__(self, vjp, in_infos, out_avals, multi):
+        self.vjp = vjp
+        self.in_infos = in_infos
+        self.out_avals = out_avals  # tuple of (shape, dtype) per output
+        self.multi = multi  # fn returned a tuple (vjp cotangent must match)
+        self.seq = next(_seq)
+
+
+def _record_op(fn, inputs, datas):
+    """Execute fn via jax.vjp and append a tape node. Called from ops.registry."""
+    from .ndarray.ndarray import NDArray
+
+    out_data, vjp_fn = jax.vjp(fn, *datas)
+    multi = isinstance(out_data, (tuple, list))
+    outs = tuple(out_data) if multi else (out_data,)
+    node = _TapeNode(
+        vjp=vjp_fn,
+        in_infos=tuple(
+            x._ag_info if isinstance(x, NDArray) else None for x in inputs
+        ),
+        out_avals=tuple((o.shape, o.dtype) for o in outs),
+        multi=multi,
+    )
+    return out_data, node
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach grad buffers, making arrays gradient sinks.
+
+    Reference: Imperative::MarkVariables (imperative.cc:134) /
+    autograd.mark_variables.
+    """
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._ag_info = AGInfo(variable=var)
+        var._grad = g
+        var._grad_req = req
+
+
+# ---------------------------------------------------------------------------
+# backward pass
+# ---------------------------------------------------------------------------
+def _zero_cotangent(shape, dtype):
+    import jax.numpy as jnp
+
+    if onp.issubdtype(onp.dtype(dtype), onp.inexact) or str(dtype) == "bfloat16":
+        return jnp.zeros(shape, dtype)
+    return onp.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def _walk(heads, head_grads):
+    """Reverse-order tape walk. Returns {id(variable_ndarray): cotangent}."""
+    import jax.numpy as jnp
+
+    # cotangent accumulators
+    node_cots: dict[int, dict[int, object]] = {}  # id(node) -> {out_idx: cot}
+    var_cots: dict[int, object] = {}  # id(var NDArray) -> cot
+    nodes: dict[int, _TapeNode] = {}
+    var_refs: dict[int, object] = {}
+
+    def _sow(info, cot):
+        if info is None:
+            return
+        if info.variable is not None:
+            v = info.variable
+            var_refs[id(v)] = v
+            prev = var_cots.get(id(v))
+            var_cots[id(v)] = cot if prev is None else prev + cot
+        else:
+            n = info.node
+            nodes[id(n)] = n
+            d = node_cots.setdefault(id(n), {})
+            prev = d.get(info.index)
+            d[info.index] = cot if prev is None else prev + cot
+
+    for h, hg in zip(heads, head_grads):
+        info = h._ag_info
+        if info is None:
+            raise MXNetError(
+                "cannot differentiate: output is not connected to any "
+                "recorded computation (did you call backward outside "
+                "autograd.record(), or forget attach_grad?)"
+            )
+        if hg is None:
+            hg = jnp.ones(h.shape, h.dtype)
+        else:
+            hg = hg._data if hasattr(hg, "_data") else jnp.asarray(hg)
+        _sow(info, hg)
+
+    # reverse execution order == valid reverse topological order; a max-heap
+    # on seq processes each node after all its consumers (they ran later)
+    import heapq
+
+    heap = [(-n.seq, id(n)) for n in nodes.values()]
+    heapq.heapify(heap)
+    done = set()
+    while heap:
+        _, nid = heapq.heappop(heap)
+        if nid in done:
+            continue
+        done.add(nid)
+        node = nodes[nid]
+        cots = node_cots.get(id(node), {})
+        full = tuple(
+            cots.get(i, _zero_cotangent(shape, dtype))
+            for i, (shape, dtype) in enumerate(node.out_avals)
+        )
+        arg = full if node.multi else full[0]
+        in_cots = node.vjp(arg)
+        for info, cot in zip(node.in_infos, in_cots):
+            if info is None or getattr(cot, "dtype", None) == jax.dtypes.float0:
+                continue
+            if info.node is not None and id(info.node) not in nodes:
+                nodes[id(info.node)] = info.node
+                heapq.heappush(heap, (-info.node.seq, id(info.node)))
+            _sow(info, cot)
+    return var_refs, var_cots
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Accumulate gradients of heads into the grad buffers of reachable variables.
+
+    Reference: autograd.backward (autograd.py:245) -> Imperative::Backward
+    (imperative.cc:387).
+    """
+    heads, head_grads = _normalize_heads(heads, head_grads)
+    var_refs, var_cots = _walk(heads, head_grads)
+    from .ndarray.ndarray import NDArray
+
+    for vid, cot in var_cots.items():
+        var = var_refs[vid]
+        req = getattr(var, "_grad_req", "write")
+        if req == "null" or var._grad is None:
+            continue
+        if req == "add":
+            var._grad._set_data(var._grad._data + cot)
+        else:
+            var._grad._set_data(cot.astype(var._grad.dtype))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Return gradients of heads w.r.t. variables (reference autograd.py:272)."""
+    from .ndarray.ndarray import NDArray
+
+    single = not isinstance(variables, (list, tuple))
+    var_list = [variables] if single else list(variables)
+    for v in var_list:
+        if v._ag_info is None or v._ag_info.variable is None:
+            raise MXNetError("autograd.grad: variables must have attached grads "
+                             "or be marked via mark_variables")
+    heads, head_grads = _normalize_heads(heads, head_grads)
+    _, var_cots = _walk(heads, head_grads)
+    outs = []
+    for v in var_list:
+        cot = var_cots.get(id(v))
+        if cot is None:
+            import jax.numpy as jnp
+
+            cot = jnp.zeros(v.shape, v.dtype)
+        outs.append(NDArray(cot))
+    return outs[0] if single else outs
+
+
+def _normalize_heads(heads, head_grads):
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+    return list(heads), list(head_grads)

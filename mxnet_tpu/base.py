@@ -1,0 +1,102 @@
+"""Base utilities: dtype handling, registries, error types.
+
+TPU-native rewrite of the roles played by the reference's ``python/mxnet/base.py``
+(lib loading / ``check_call``) and dmlc-core's registry. There is no C library to
+load: the "backend" is JAX/XLA over PJRT, so this module only carries shared
+plumbing (dtype canonicalization, a generic registry used by optimizers /
+initializers / kvstore backends, and the MXNetError exception type).
+
+Reference: python/mxnet/base.py, 3rdparty/dmlc-core registry pattern.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["MXNetError", "Registry", "canonical_dtype", "dtype_name", "string_types"]
+
+string_types = (str,)
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (reference: MXGetLastError / dmlc::Error)."""
+
+
+# ---------------------------------------------------------------------------
+# dtypes
+# ---------------------------------------------------------------------------
+# JAX runs with x64 disabled (TPU-native: f32/bf16 are the MXU-friendly types).
+# float64/int64 inputs are canonicalized by JAX itself; we keep names stable.
+_DTYPE_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bool_": "bool",
+}
+
+
+def canonical_dtype(dtype):
+    """Return a numpy dtype for a user-supplied dtype spec (str/np.dtype/None)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        dtype = _DTYPE_ALIASES.get(dtype, dtype)
+        if dtype == "bfloat16":
+            import jax.numpy as jnp
+
+            return jnp.bfloat16
+    return onp.dtype(dtype) if not _is_bfloat16(dtype) else dtype
+
+
+def _is_bfloat16(dtype) -> bool:
+    return getattr(dtype, "__name__", None) == "bfloat16" or str(dtype) == "bfloat16"
+
+
+def dtype_name(dtype) -> str:
+    """String name of a dtype ('float32', 'bfloat16', ...)."""
+    if dtype is None:
+        return "None"
+    return str(onp.dtype(dtype)) if not _is_bfloat16(dtype) else "bfloat16"
+
+
+# ---------------------------------------------------------------------------
+# generic registry (reference: dmlc registry / mx.operator register patterns)
+# ---------------------------------------------------------------------------
+class Registry:
+    """Name -> object registry with decorator support and alias handling."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._store: dict[str, object] = {}
+
+    def register(self, obj=None, name=None):
+        def _do(o):
+            key = (name or getattr(o, "__name__", None) or str(o)).lower()
+            self._store[key] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def alias(self, name: str, target: str):
+        self._store[name.lower()] = self._store[target.lower()]
+
+    def get(self, name: str):
+        key = name.lower() if isinstance(name, str) else name
+        if key not in self._store:
+            raise KeyError(
+                f"{self.kind} '{name}' is not registered. "
+                f"Known: {sorted(self._store)}"
+            )
+        return self._store[key]
+
+    def find(self, name: str):
+        return self._store.get(name.lower() if isinstance(name, str) else name)
+
+    def list(self):
+        return sorted(self._store)
+
+    def __contains__(self, name):
+        return (name.lower() if isinstance(name, str) else name) in self._store

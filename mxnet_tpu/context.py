@@ -1,0 +1,175 @@
+"""Device / Context model.
+
+TPU-native equivalent of the reference's ``python/mxnet/context.py`` and the C++
+``Context`` (include/mxnet/base.h:94-118, device types kCPU=1 kGPU=2 kCPUPinned=3
+kCPUShared=5). Here the first-class accelerator is TPU: ``mx.tpu()`` resolves to a
+PJRT TPU device through JAX; ``mx.cpu()`` resolves to the host platform. ``gpu`` is
+accepted as an alias for the local accelerator so unmodified reference scripts run.
+
+A Context is a lightweight (device_type, device_id) value object; the actual JAX
+``Device`` is resolved lazily (so importing the package never forces a TPU runtime
+handshake — important for fork-based DataLoader workers, see reference
+src/initialize.cc:71-97 for the class of bug this avoids).
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = [
+    "Context",
+    "cpu",
+    "cpu_pinned",
+    "gpu",
+    "tpu",
+    "device",
+    "current_context",
+    "current_device",
+    "num_gpus",
+    "num_tpus",
+]
+
+_DEVTYPES = ("cpu", "tpu", "cpu_pinned", "cpu_shared", "gpu")
+
+
+class Context:
+    """Execution device handle.
+
+    Reference parity: ``mx.Context`` — usable as a context manager
+    (``with mx.tpu(0): ...``) and as the ``ctx``/``device`` argument everywhere.
+    """
+
+    _local = threading.local()
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if device_type not in _DEVTYPES:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- resolution to a JAX / PJRT device ---------------------------------
+    @property
+    def _platform(self) -> str:
+        if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
+            return "cpu"
+        if self.device_type == "tpu":
+            return "tpu"
+        # 'gpu' alias: whatever the default accelerator platform is
+        import jax
+
+        plat = jax.default_backend()
+        return plat if plat != "cpu" else "cpu"
+
+    def jax_device(self):
+        """Resolve to the concrete ``jax.Device`` (PJRT device)."""
+        import jax
+
+        plat = self._platform
+        try:
+            devs = jax.devices(plat)
+        except RuntimeError as e:  # platform absent
+            if plat != "cpu":
+                raise MXNetError(
+                    f"no {plat} devices available (requested {self})"
+                ) from e
+            raise
+        if self.device_id >= len(devs):
+            raise MXNetError(
+                f"{self} out of range: only {len(devs)} {plat} device(s) present"
+            )
+        return devs[self.device_id]
+
+    # -- context-manager protocol (thread-local stack, like reference) ------
+    def __enter__(self):
+        stack = getattr(Context._local, "stack", None)
+        if stack is None:
+            stack = Context._local.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._local.stack.pop()
+
+    # -- value semantics ----------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self):
+        return repr(self)
+
+
+Device = Context  # mxnet 2.x renamed Context -> Device; keep both names
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    # On TPU hosts all host memory goes through the same PJRT transfer path;
+    # pinned is an alias of cpu kept for API parity.
+    return Context("cpu", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias for the local accelerator so reference GPU scripts run unmodified."""
+    return Context("gpu", device_id)
+
+
+def device(dev: str | Context | None = None, device_id: int = 0) -> Context:
+    if dev is None:
+        return current_context()
+    if isinstance(dev, Context):
+        return dev
+    if isinstance(dev, str):
+        if ":" in dev:
+            kind, idx = dev.split(":")
+            return Context(kind, int(idx))
+        return Context(dev, device_id)
+    raise MXNetError(f"cannot interpret {dev!r} as a device")
+
+
+def default_context() -> Context:
+    """The default device: TPU if the runtime has one, else CPU."""
+    import jax
+
+    return tpu(0) if jax.default_backend() == "tpu" else cpu(0)
+
+
+def current_context() -> Context:
+    stack = getattr(Context._local, "stack", None)
+    if stack:
+        return stack[-1]
+    return default_context()
+
+
+current_device = current_context
+
+
+def num_gpus() -> int:
+    """Reference-parity probe; counts local accelerators."""
+    return num_tpus()
+
+
+def num_tpus() -> int:
+    import jax
+
+    try:
+        return len(jax.devices("tpu"))
+    except RuntimeError:
+        return 0

@@ -1,0 +1,93 @@
+"""Engine facade: async dispatch, synchronization, exception rethrow-at-sync.
+
+The reference's dependency engine (src/engine/threaded_engine*.cc, N1 in SURVEY)
+schedules every kernel on worker threads and tracks read/write dependencies per
+NDArray var. On TPU, XLA/PJRT *is* the asynchronous engine: every dispatched
+computation returns immediately with a future-backed ``jax.Array``; data
+dependencies are expressed by the dataflow itself, and the runtime orders
+executions per device. What remains for the framework is the *facade*:
+
+- ``wait_for_var`` / ``wait_all``  (reference: Engine::WaitForVar/WaitForAll,
+  include/mxnet/engine.h) — block on PJRT events.
+- exception rethrow at sync points (reference: threaded_engine.h:387 captures
+  std::exception_ptr, rethrown at WaitToRead/asnumpy; tests
+  tests/python/unittest/test_exc_handling.py). JAX raises either at dispatch
+  (eager) or when the poisoned future is consumed — we normalize both into
+  MXNetError at the sync point.
+- engine-type selection (reference: MXNET_ENGINE_TYPE, src/engine/engine.cc:32):
+  ``NaiveEngine`` maps to blocking after every op (debug mode); the default
+  threaded engine maps to JAX's native async dispatch.
+- op bulking (reference: threaded_engine.h:414): subsumed by CachedOp whole-graph
+  jit; ``bulk`` is kept as a no-op context manager for API parity.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["wait_all", "wait_for_var", "is_naive", "bulk", "set_bulk_size"]
+
+_NAIVE = os.environ.get("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+
+
+def is_naive() -> bool:
+    """True when every op should synchronize immediately (debugging mode)."""
+    return _NAIVE
+
+
+def set_naive(flag: bool) -> None:
+    global _NAIVE
+    _NAIVE = bool(flag)
+
+
+def wait_for_var(data) -> None:
+    """Block until ``data`` (a jax.Array or pytree) is computed on device.
+
+    Reference: Engine::WaitForVar / NDArray::WaitToRead (ndarray.h:391).
+    Device-side errors surface here as MXNetError.
+    """
+    try:
+        jax.block_until_ready(data)
+    except MXNetError:
+        raise
+    except Exception as e:  # noqa: BLE001 — normalize XLA/PJRT errors
+        raise MXNetError(str(e)) from e
+
+
+def wait_all() -> None:
+    """Block until all dispatched work on all devices completes.
+
+    Reference: MXNDArrayWaitAll / Engine::WaitForAll. PJRT has no global drain
+    primitive; JAX's dispatch is synchronous-enqueue so by the time any array is
+    ready all previously enqueued programs on its device have run. We keep a
+    registry-free implementation: a trivial device barrier per device.
+    """
+    try:
+        for dev in jax.devices():
+            jax.device_put(0, dev).block_until_ready()
+    except Exception as e:  # noqa: BLE001
+        raise MXNetError(str(e)) from e
+
+
+_BULK_SIZE = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
+
+
+def set_bulk_size(size: int) -> int:
+    """Reference parity (mx.engine.set_bulk_size); bulking is native under jit."""
+    global _BULK_SIZE
+    prev, _BULK_SIZE = _BULK_SIZE, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size: int = 15):
+    """No-op context manager kept for parity (reference: mx.engine.bulk)."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -1,0 +1,15 @@
+"""Gluon — the imperative/hybrid user API (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import data
+from .. import metric  # gluon.metric parity (reference moved metrics here)
+from . import rnn
+from . import model_zoo
+from . import contrib
+
+__all__ = ["Parameter", "Constant", "DeferredInitializationError", "Block",
+           "HybridBlock", "SymbolBlock", "Trainer", "nn", "loss", "data",
+           "metric", "rnn", "model_zoo", "contrib"]

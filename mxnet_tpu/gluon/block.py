@@ -1,0 +1,337 @@
+"""gluon.Block / HybridBlock — the model-authoring API.
+
+Reference: python/mxnet/gluon/block.py (Block:203, HybridBlock:998,
+hybridize:714/1419, _build_cache:1135 -> CachedOp:1251, export:1514,
+SymbolBlock:1716). TPU-native execution model:
+
+- a plain Block runs eagerly: each op dispatches async through XLA;
+- ``hybridize()`` switches __call__ to a compiled path: the forward is traced
+  ONCE via deferred compute (real arrays, real shapes) into a Symbol and
+  compiled by CachedOp into a single jitted XLA program — the reference's
+  ``static_alloc=True, static_shape=True`` fast path is simply the default.
+  Re-tracing happens per input signature (shape/dtype/train-flag), mirroring
+  CachedOp's shape-keyed graph cache (src/imperative/cached_op.cc:168).
+- parameters are passed to the compiled program as inputs every call, so
+  optimizer updates never invalidate the cache; BatchNorm running stats come
+  back as extra outputs (aux updates) and are written back post-call.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter, DeferredInitializationError
+from .. import autograd
+from .. import initializer as init_mod
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class Block:
+    """Base container (reference: gluon/block.py:203)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__setattr__("_children", OrderedDict())
+        super().__setattr__("_reg_params", OrderedDict())
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    # -- attribute registration --------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self._reg_params[name] = value
+        elif isinstance(value, Block):
+            self._children[name] = value
+        super().__setattr__(name, value)
+
+    # -- parameter management ----------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        ret = OrderedDict()
+        for name, p in self._reg_params.items():
+            key = prefix + name
+            p._name = key
+            ret[key] = p
+        for cname, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + cname + "."))
+        return ret
+
+    def collect_params(self, select=None):
+        params = self._collect_params_with_prefix()
+        if select is None:
+            return params
+        pat = re.compile(select)
+        return OrderedDict((k, v) for k, v in params.items() if pat.match(k))
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False, device=None):
+        if init is None:
+            init = init_mod.Uniform(0.07)
+        for _, param in self.collect_params().items():
+            param.initialize(ctx=device or ctx, default_init=init,
+                             force_reinit=force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for param in self.collect_params().values():
+            param.cast(dtype)
+        for child in self._children.values():
+            child.cast(dtype)
+
+    def zero_grad(self):
+        for param in self.collect_params().values():
+            param.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for param in self.collect_params().values():
+            param.reset_ctx(ctx)
+
+    reset_device = reset_ctx
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def register_child(self, block, name=None):
+        name = name or str(len(self._children))
+        self._children[name] = block
+        super().__setattr__(name, block)
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return hook
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return hook
+
+    # -- persistence (reference: block.py:341 save_parameters) --------------
+    def save_parameters(self, filename, deduplicate=False):
+        params = self.collect_params()
+        arrays = {}
+        for name, p in params.items():
+            if p._data is not None:
+                d = p.data().asnumpy() if str(p.dtype) != "bfloat16" else \
+                    p.data().astype("float32").asnumpy()
+                arrays[name] = d
+        onp.savez(filename, **arrays)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current", device=None):
+        import jax.numpy as jnp
+
+        loaded = dict(onp.load(filename))
+        params = self.collect_params()
+        for name, p in params.items():
+            if name not in loaded:
+                if not allow_missing:
+                    raise MXNetError(f"parameter {name} missing in "
+                                     f"{filename}")
+                continue
+            data = loaded.pop(name)
+            tgt_dtype = p.dtype if dtype_source == "current" else data.dtype
+            p.set_data(jnp.asarray(data).astype(
+                "bfloat16" if str(tgt_dtype) == "bfloat16" else tgt_dtype))
+            if ctx is not None or device is not None:
+                p.reset_ctx(device or ctx)
+        if loaded and not ignore_extra:
+            raise MXNetError(f"extra parameters in file: {sorted(loaded)}")
+
+    def save(self, prefix):
+        self.save_parameters(f"{prefix}-model.params.npz")
+
+    def load(self, prefix):
+        self.load_parameters(f"{prefix}-model.params.npz")
+
+    # -- execution ----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        lines = [f"{type(self).__name__}:"]
+        for name, p in self.collect_params().items():
+            lines.append(f"  {name:<40} {str(p.shape):<20} {p.dtype}")
+        n = sum(int(onp.prod(p.shape)) for p in self.collect_params().values()
+                if p.shape)
+        lines.append(f"  total parameters: {n}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        s = f"{type(self).__name__}("
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            s += f"\n  ({name}): {child_repr}"
+        return s + ("\n)" if self._children else ")")
+
+
+class HybridBlock(Block):
+    """Block that can compile its forward into one XLA program."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached = {}  # signature -> (CachedOp, out_tree, param_list)
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._cached = {}
+        super().hybridize(active, **kwargs)
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        """Reference parity (block.py optimize_for): backends map to XLA;
+        hybridize + warm the cache."""
+        self.hybridize()
+        self(x, *args)
+
+    def infer_shape(self, *args):
+        """Hook for subclasses with deferred-shape parameters."""
+
+    def _ensure_initialized(self, *args):
+        params = self.collect_params()
+        deferred = [p for p in params.values() if p._data is None and
+                    p._deferred_init is not None]
+        if not deferred:
+            return
+        # run one eager forward to let layers infer shapes & finish init
+        self.infer_shape(*args)
+        still = [p for p in params.values() if p._data is None and
+                 p._deferred_init is not None]
+        if still:
+            with autograd.pause():
+                self.forward(*args)
+
+    def __call__(self, *args, **kwargs):
+        from .. import _deferred_compute as dc
+
+        if not self._active or dc.is_tracing():
+            return super().__call__(*args, **kwargs)
+        nd_idx = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+        static = tuple((i, a) for i, a in enumerate(args)
+                       if not isinstance(a, NDArray))
+        hashable = (int, float, str, bool, tuple, type(None))
+        for i, a in static:
+            if not isinstance(a, hashable):
+                return super().__call__(*args, **kwargs)  # unhashable: eager
+        for v in kwargs.values():
+            if not isinstance(v, hashable):
+                return super().__call__(*args, **kwargs)
+        sig = (tuple((args[i].shape, str(args[i].dtype)) for i in nd_idx),
+               static, autograd.is_training(),
+               tuple(sorted(kwargs.items())))
+        entry = self._cached.get(sig)
+        if entry is None:
+            entry = self._build_cache(nd_idx, args, kwargs)
+            self._cached[sig] = entry
+        cop, out_tree, param_arrays = entry
+        from ..cached_op import unflatten_out
+
+        datas = [args[i] for i in nd_idx] + param_arrays
+        out = cop(*datas)
+        flat = list(out) if isinstance(out, tuple) else [out]
+        return unflatten_out(flat, out_tree)
+
+    def _build_cache(self, nd_idx, args, kwargs):
+        """Trace forward into a CachedOp (reference: block.py:1135
+        _build_cache via deferred compute)."""
+        from ..cached_op import trace
+
+        self._ensure_initialized(*args)
+        params = [(name, p.data())
+                  for name, p in self.collect_params().items()
+                  if p._data is not None]
+
+        def fn(*data_args):
+            full = list(args)
+            for i, a in zip(nd_idx, data_args):
+                full[i] = a
+            return self.forward(*full, **kwargs)
+
+        tree, _, cop = trace(fn, [args[i] for i in nd_idx], params)
+        return cop, tree, [arr for _, arr in params]
+
+    # -- export (reference: block.py:1514) ----------------------------------
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Serialize symbol JSON + params for deployment."""
+        if not self._cached:
+            raise MXNetError("hybridize() and run a forward pass before "
+                             "export()")
+        (cop, tree, param_arrays) = next(iter(self._cached.values()))
+        sym_file = f"{path}-symbol.json"
+        cop.sym.save(sym_file)
+        params = {name: p.data().asnumpy()
+                  for name, p in self.collect_params().items()
+                  if p._data is not None}
+        param_file = f"{path}-{epoch:04d}.params.npz"
+        onp.savez(param_file, **params)
+        return sym_file, param_file
+
+
+class SymbolBlock(HybridBlock):
+    """Run a loaded Symbol as a Block (reference: block.py SymbolBlock:1716)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__()
+        from ..symbol.symbol import Symbol, topo_sort
+
+        if isinstance(outputs, (list, tuple)):
+            entries = []
+            for o in outputs:
+                entries.extend(o._entries)
+            outputs = Symbol(entries)
+        self._sym = outputs
+        input_names = [s.name if hasattr(s, "name") else s for s in
+                       (inputs if isinstance(inputs, (list, tuple))
+                        else [inputs])]
+        self._input_names = input_names
+        var_nodes = [n for n in topo_sort(outputs._entries) if n.is_var]
+        self._data_nodes = [n for n in var_nodes if n.name in input_names]
+        self._param_nodes = [n for n in var_nodes
+                             if n.name not in input_names]
+        for n in self._param_nodes:
+            p = Parameter(name=n.name, allow_deferred_init=True)
+            if params and n.name in params:
+                p.set_data(params[n.name]._data
+                           if isinstance(params[n.name], NDArray)
+                           else params[n.name])
+            self._reg_params[n.name] = p
+        self._cop = None
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None,
+                allow_missing_params=False):
+        from ..symbol.symbol import Symbol
+
+        sym = Symbol.load(symbol_file)
+        params = {}
+        if param_file:
+            params = {k: NDArray(v)
+                      for k, v in onp.load(param_file).items()}
+        return SymbolBlock(sym, [input_names] if isinstance(input_names, str)
+                           else input_names, params)
+
+    def forward(self, *args):
+        from ..cached_op import CachedOp
+
+        if self._cop is None:
+            self._cop = CachedOp(
+                self._sym, self._data_nodes + self._param_nodes)
+        datas = list(args) + [self._reg_params[n.name].data()
+                              for n in self._param_nodes]
+        return self._cop(*datas)

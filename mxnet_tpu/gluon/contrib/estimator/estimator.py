@@ -1,0 +1,84 @@
+"""Estimator: high-level fit loop (reference: gluon/contrib/estimator/
+estimator.py, Estimator.fit:327)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from .... import autograd
+from ....metric import EvalMetric, Loss as LossMetric, Accuracy
+from ..estimator.event_handler import (TrainBegin, TrainEnd, EpochBegin,
+                                       EpochEnd, BatchBegin, BatchEnd,
+                                       StoppingHandler, MetricHandler,
+                                       LoggingHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, device=None):
+        self.net = net
+        self.loss = loss
+        self.trainer = trainer
+        self.context = device or context
+        self.train_metrics = train_metrics or [Accuracy()]
+        if not isinstance(self.train_metrics, list):
+            self.train_metrics = [self.train_metrics]
+        self.train_loss_metric = LossMetric(name="train_loss")
+
+    def _batch_fn(self, batch):
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def fit_batch(self, batch, batch_axis=0):
+        data, label = self._batch_fn(batch)
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        if epochs is None and batches is None:
+            epochs = 1
+        handlers = list(event_handlers or [])
+        handlers.append(StoppingHandler(epochs, batches))
+        handlers.append(MetricHandler(self.train_metrics))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(metrics=self.train_metrics))
+
+        def dispatch(kind, **kwargs):
+            stop = False
+            for h in handlers:
+                if hasattr(h, kind):
+                    res = getattr(h, kind)(self, **kwargs)
+                    stop = stop or bool(res)
+            return stop
+
+        dispatch("train_begin")
+        stop = False
+        while not stop:
+            dispatch("epoch_begin")
+            for batch in train_data:
+                dispatch("batch_begin")
+                data, label, pred, loss = self.fit_batch(batch, batch_axis)
+                if self.trainer is not None:
+                    self.trainer.step(data.shape[batch_axis])
+                self.train_loss_metric.update(0, loss)
+                if dispatch("batch_end", pred=pred, label=label, loss=loss):
+                    stop = True
+                    break
+            if dispatch("epoch_end") or stop:
+                stop = True
+        dispatch("train_end")
+
+    def evaluate(self, val_data, val_metrics=None, batch_axis=0):
+        metrics = val_metrics or self.train_metrics
+        for m in metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = self._batch_fn(batch)
+            pred = self.net(data)
+            for m in metrics:
+                m.update(label, pred)
+        return metrics

@@ -1,0 +1,256 @@
+"""Estimator event handlers (reference: gluon/contrib/estimator/
+event_handler.py — LoggingHandler:226, CheckpointHandler:336,
+EarlyStoppingHandler:614)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+import numpy as onp
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            from ....metric import Loss as LossMetric
+
+            if isinstance(m, LossMetric):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    def __init__(self, log_interval="epoch", metrics=None, priority=-1000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        t = time.time() - self.train_start
+        self.logger.info("Training finished in %.1fs", t)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msgs = [f"{n}={v:.4f}" for m in self.metrics
+                for n, v in m.get_name_value()]
+        self.logger.info("Epoch %d finished in %.1fs: %s",
+                         self.current_epoch, time.time() - self.epoch_start,
+                         " ".join(msgs))
+        self.current_epoch += 1
+        self.batch_index = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msgs = [f"{n}={v:.4f}" for m in self.metrics
+                    for n, v in m.get_name_value()]
+            self.logger.info("[Epoch %d][Batch %d] %s", self.current_epoch,
+                             self.batch_index, " ".join(msgs))
+        self.batch_index += 1
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save params + trainer states periodically (reference:
+    event_handler.py:336)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.best = None
+        self.mode = mode
+        self.saved = []
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+
+    def _save(self, estimator, tag):
+        prefix = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
+        estimator.net.save_parameters(prefix + ".params.npz")
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(prefix + ".states")
+        self.saved.append(prefix)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            for suffix in (".params.npz", ".states"):
+                try:
+                    os.remove(old + suffix)
+                except OSError:
+                    pass
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator, f"batch{self.current_batch}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save(estimator, f"epoch{self.current_epoch}")
+            if self.save_best and self.monitor is not None:
+                _, value = self.monitor.get()
+                better = (self.best is None or
+                          (value < self.best if self.mode != "max"
+                           else value > self.best))
+                if better:
+                    self.best = value
+                    self._save(estimator, "best")
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when a metric stops improving (reference: event_handler.py:614)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.mode = mode
+        self.baseline = baseline
+        self.wait = 0
+        self.best = None
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "max":
+            return value > self.best + self.min_delta
+        return value < self.best - self.min_delta
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, value = self.monitor.get()
+        if onp.isnan(value):
+            self.current_epoch += 1
+            return self.stop_training
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        self.current_epoch += 1
+        return self.stop_training
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            logging.getLogger("mxnet_tpu.estimator").info(
+                "Early stopping at epoch %d", self.stopped_epoch)

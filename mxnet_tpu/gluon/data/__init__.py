@@ -1,0 +1,11 @@
+"""gluon.data (reference: python/mxnet/gluon/data/)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset
+from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
+                      IntervalSampler, FilterSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "Sampler",
+           "SequentialSampler", "RandomSampler", "BatchSampler",
+           "IntervalSampler", "FilterSampler", "DataLoader",
+           "default_batchify_fn", "vision"]

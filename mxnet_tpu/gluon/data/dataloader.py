@@ -1,0 +1,133 @@
+"""DataLoader: batched, shuffled, prefetching input pipeline.
+
+Reference: python/mxnet/gluon/data/dataloader.py — fork-based worker processes
+with shared-memory NDArray pickling (dataloader.py:67-138, kCPUShared storage)
+plus pthread_atfork engine fixups (src/initialize.cc:71-97). TPU-native
+redesign: PJRT clients do not survive fork, and the heavy work (decode/augment)
+is numpy/host-bound, so workers are THREADS feeding a bounded prefetch queue
+(NumPy releases the GIL for the hot loops) and batches stage to HBM
+asynchronously. The batchify step produces host numpy; transfer to device is a
+single contiguous jax.device_put per batch (the reference's copy-worker role,
+threaded_engine_perdevice.cc:138).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: dataloader default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return NDArray(onp.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(d)) for d in zip(*data))
+    arr = onp.asarray(data)
+    if arr.dtype == onp.float64:
+        arr = arr.astype(onp.float32)
+    return NDArray(arr)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when no batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle and sampler are mutually exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(1, prefetch if prefetch is not None
+                             else 2 * max(1, self._num_workers))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load_batch(indices)
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        batches = list(self._batch_sampler)
+        out_q: dict[int, object] = {}
+        done = threading.Event()
+        lock = threading.Condition()
+        idx_iter = iter(enumerate(batches))
+        idx_lock = threading.Lock()
+        error: list[BaseException] = []
+
+        def worker():
+            while not done.is_set():
+                with idx_lock:
+                    try:
+                        i, indices = next(idx_iter)
+                    except StopIteration:
+                        return
+                try:
+                    batch = self._load_batch(indices)
+                except BaseException as e:  # noqa: BLE001
+                    with lock:
+                        error.append(e)
+                        lock.notify_all()
+                    return
+                with lock:
+                    while (len(out_q) >= self._prefetch and
+                           min(out_q, default=i) < i and not done.is_set()):
+                        lock.wait(0.1)
+                    out_q[i] = batch
+                    lock.notify_all()
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(len(batches)):
+                with lock:
+                    deadline = self._timeout
+                    while i not in out_q and not error:
+                        if not lock.wait(0.5):
+                            deadline -= 0.5
+                            if deadline <= 0:
+                                raise MXNetError("DataLoader worker timeout")
+                    if error:
+                        raise error[0]
+                    batch = out_q.pop(i)
+                    lock.notify_all()
+                yield batch
+        finally:
+            done.set()
+            for t in threads:
+                t.join(timeout=1.0)
+
+    def __del__(self):
+        pass

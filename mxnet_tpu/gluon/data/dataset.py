@@ -1,0 +1,101 @@
+"""Datasets (reference: python/mxnet/gluon/data/dataset.py)."""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset"]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn):
+        return SimpleDataset([s for s in self if fn(s)])
+
+    def shard(self, num_shards, index):
+        n = len(self)
+        per = (n + num_shards - 1) // num_shards
+        return SimpleDataset([self[i] for i in
+                              range(index * per, min(n, (index + 1) * per))])
+
+    def take(self, count):
+        return SimpleDataset([self[i] for i in range(min(count, len(self)))])
+
+    def sample(self, sampler):
+        return _SampledDataset(self, sampler)
+
+    def transform(self, fn, lazy=True):
+        return _LazyTransformDataset(self, fn)
+
+    def transform_first(self, fn, lazy=True):
+        def f(*sample):
+            if len(sample) == 1:
+                return fn(sample[0])
+            return (fn(sample[0]),) + sample[1:]
+
+        return _LazyTransformDataset(self, f, unpack=True)
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, dataset, fn, unpack=False):
+        self._dataset = dataset
+        self._fn = fn
+        self._unpack = unpack
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, idx):
+        item = self._dataset[idx]
+        if self._unpack and isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _SampledDataset(Dataset):
+    def __init__(self, dataset, sampler):
+        self._dataset = dataset
+        self._indices = list(sampler)
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._dataset[self._indices[idx]]
+
+
+class ArrayDataset(Dataset):
+    """Zip of arrays/lists (reference: dataset.py ArrayDataset)."""
+
+    def __init__(self, *args):
+        if not args:
+            raise MXNetError("ArrayDataset needs at least one array")
+        self._length = len(args[0])
+        for a in args:
+            if len(a) != self._length:
+                raise MXNetError("all arrays must have the same length")
+        self._data = list(args)
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)

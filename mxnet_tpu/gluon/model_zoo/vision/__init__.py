@@ -1,0 +1,33 @@
+"""Model zoo (reference: python/mxnet/gluon/model_zoo/vision/__init__.py,
+get_model:91)."""
+from __future__ import annotations
+
+from ....base import MXNetError
+from .resnet import *  # noqa: F401,F403
+from .resnet import __all__ as _resnet_all
+from .alexnet import alexnet, AlexNet
+from .vgg import vgg11, vgg13, vgg16, vgg19, VGG
+from .mlp import mlp, MLP
+
+_models = {}
+
+
+def _register_models():
+    from . import resnet as _r
+
+    for name in _resnet_all:
+        if name.startswith("resnet") and name[6].isdigit():
+            _models[name] = getattr(_r, name)
+    _models.update({"alexnet": alexnet, "vgg11": vgg11, "vgg13": vgg13,
+                    "vgg16": vgg16, "vgg19": vgg19, "mlp": mlp})
+
+
+_register_models()
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(f"model {name!r} not in zoo; available: "
+                         f"{sorted(_models)}")
+    return _models[name](**kwargs)

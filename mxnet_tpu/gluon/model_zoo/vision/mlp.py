@@ -1,0 +1,26 @@
+"""MLP for the MNIST north-star config (BASELINE config 1; reference:
+example/gluon/mnist/mnist.py net shape 128-64-10)."""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["MLP", "mlp"]
+
+
+class MLP(HybridBlock):
+    def __init__(self, hidden=(128, 64), classes=10, activation="relu",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential()
+        for h in hidden:
+            self.body.add(nn.Dense(h, activation=activation))
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.body(x))
+
+
+def mlp(**kwargs):
+    kwargs.pop("pretrained", None)
+    return MLP(**kwargs)

@@ -1,0 +1,4 @@
+"""gluon.nn — neural network layers (reference: python/mxnet/gluon/nn/)."""
+from .basic_layers import *  # noqa: F401,F403
+from .basic_layers import SyncBatchNorm  # noqa: F401
+from .conv_layers import *  # noqa: F401,F403

@@ -1,0 +1,427 @@
+"""Core neural-network layers.
+
+Reference: python/mxnet/gluon/nn/basic_layers.py + activations.py. Layers are
+thin parameter containers; the math lives in registered ops (mxnet_tpu.ops.nn)
+that lower to XLA — under hybridize a whole network fuses into one program.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+from ... import initializer as init_mod
+from ... import numpy_extension as npx
+from ... import autograd
+from ... import _deferred_compute as dc
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
+           "Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish",
+           "SiLU", "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+           "RMSNorm", "Embedding", "Lambda", "HybridLambda", "Identity",
+           "Concatenate", "HybridConcatenate"]
+
+
+class Sequential(Block):
+    """Sequential container (reference: nn/basic_layers.py Sequential)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        children = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*children[key])
+            return net
+        return children[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(Sequential, HybridBlock):
+    def __init__(self):
+        HybridBlock.__init__(self)
+
+
+class Dense(HybridBlock):
+    """Fully connected layer (reference: nn/basic_layers.py Dense ->
+    src/operator/nn/fully_connected.cc). Weight layout (units, in_units) hits
+    the MXU as one matmul."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter(shape=(units, in_units), dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter(shape=(units,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+
+    def _infer(self, x):
+        if self.weight._data is None:
+            in_units = (int(x.size // x.shape[0]) if self._flatten
+                        else x.shape[-1])
+            self.weight.shape = (self._units, in_units)
+            self.weight._finish_deferred_init()
+        if self.bias is not None and self.bias._data is None:
+            self.bias._finish_deferred_init()
+
+    def forward(self, x):
+        self._infer(x)
+        out = npx.fully_connected(
+            x, self.weight.data(),
+            self.bias.data() if self.bias is not None else None,
+            num_hidden=self._units, no_bias=self.bias is None,
+            flatten=self._flatten)
+        if self._activation is not None:
+            out = npx.activation(out, act_type=self._activation)
+        return out
+
+    def __repr__(self):
+        return (f"Dense({self._units}, "
+                f"in={self.weight.shape[1] or '?'})")
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def forward(self, x):
+        if self._rate <= 0:
+            return x
+        return npx.dropout(x, p=self._rate)
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act = activation
+
+    def forward(self, x):
+        return npx.activation(x, act_type=self._act)
+
+    def __repr__(self):
+        return f"Activation({self._act})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=init_mod.Constant(0.25), in_channels=1,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = Parameter(shape=(in_channels,), init=alpha_initializer)
+
+    def forward(self, x):
+        return npx.leaky_relu(x, self.alpha.data(), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", **kwargs):
+        super().__init__(**kwargs)
+        self._approx = approximation
+
+    def forward(self, x):
+        return npx.leaky_relu(
+            x, act_type="gelu" if self._approx == "erf" else "gelu_tanh")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def forward(self, x):
+        from ... import np
+
+        return x * npx.sigmoid(x * self._beta)
+
+
+SiLU = Swish
+
+
+class _NormBase(HybridBlock):
+    def _make_params(self, num_features, center, scale, dtype,
+                     gamma_initializer="ones", beta_initializer="zeros"):
+        self.gamma = Parameter(shape=(num_features,), dtype=dtype,
+                               init=gamma_initializer,
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter(shape=(num_features,), dtype=dtype,
+                              init=beta_initializer,
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+
+
+class BatchNorm(_NormBase):
+    """Batch normalization (reference: nn/basic_layers.py BatchNorm ->
+    src/operator/nn/batch_norm.cc).
+
+    Functional aux-state handling: in training mode the op RETURNS updated
+    running stats; eagerly they are written straight back, under hybridize
+    they become extra graph outputs written back after each compiled call
+    (dc.register_aux_update)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._use_global_stats = use_global_stats
+        self._scale = scale
+        self._make_params(in_channels or 0, center, scale, dtype,
+                          gamma_initializer, beta_initializer)
+        self.running_mean = Parameter(
+            shape=(in_channels or 0,), dtype=dtype,
+            init=running_mean_initializer, allow_deferred_init=True,
+            grad_req="null")
+        self.running_var = Parameter(
+            shape=(in_channels or 0,), dtype=dtype,
+            init=running_variance_initializer, allow_deferred_init=True,
+            grad_req="null")
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if in_channels:
+                p.shape = (in_channels,)
+
+    def _infer(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if p._data is None:
+                p.shape = (c,)
+                p._finish_deferred_init()
+
+    def forward(self, x):
+        self._infer(x)
+        out, new_mean, new_var = npx.batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._eps, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+        if autograd.is_training() and not self._use_global_stats:
+            if dc.is_tracing():
+                dc.register_aux_update(self.running_mean.data(), new_mean)
+                dc.register_aux_update(self.running_var.data(), new_var)
+            else:
+                self.running_mean.data()._set_data(new_mean._data)
+                self.running_var.data()._set_data(new_var._data)
+        return out
+
+    def __repr__(self):
+        return f"BatchNorm(axis={self._axis}, eps={self._eps})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BN: under pjit/shard_map the batch axis reduction is
+    global automatically, so this is BatchNorm (kept for API parity)."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class LayerNorm(_NormBase):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._eps = epsilon
+        self._make_params(in_channels or 0, center, scale, dtype,
+                          gamma_initializer, beta_initializer)
+
+    def _infer(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if p._data is None:
+                p.shape = (c,)
+                p._finish_deferred_init()
+
+    def forward(self, x):
+        self._infer(x)
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._eps)
+
+
+class GroupNorm(_NormBase):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._eps = epsilon
+        self._make_params(in_channels or 0, center, scale, "float32",
+                          gamma_initializer, beta_initializer)
+
+    def _infer(self, x):
+        for p in (self.gamma, self.beta):
+            if p._data is None:
+                p.shape = (x.shape[1],)
+                p._finish_deferred_init()
+
+    def forward(self, x):
+        self._infer(x)
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._eps)
+
+
+class InstanceNorm(_NormBase):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._eps = epsilon
+        self._make_params(in_channels or 0, center, scale, "float32",
+                          gamma_initializer, beta_initializer)
+
+    def _infer(self, x):
+        for p in (self.gamma, self.beta):
+            if p._data is None:
+                p.shape = (x.shape[1],)
+                p._finish_deferred_init()
+
+    def forward(self, x):
+        self._infer(x)
+        return npx.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                 eps=self._eps)
+
+
+class RMSNorm(HybridBlock):
+    """TPU-native extra: RMSNorm (transformer stacks)."""
+
+    def __init__(self, axis=-1, epsilon=1e-6, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._eps = epsilon
+        self.gamma = Parameter(shape=(in_channels or 0,), init="ones",
+                               allow_deferred_init=True)
+
+    def forward(self, x):
+        if self.gamma._data is None:
+            self.gamma.shape = (x.shape[self._axis],)
+            self.gamma._finish_deferred_init()
+        return npx.rms_norm(x, self.gamma.data(), axis=self._axis,
+                            eps=self._eps)
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter(shape=(input_dim, output_dim), dtype=dtype,
+                                init=weight_initializer)
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data(),
+                             input_dim=self._input_dim,
+                             output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Lambda(Block):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(function, str):
+            from ... import np as _np
+
+            function = getattr(_np, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock, Lambda):
+    def __init__(self, function, **kwargs):
+        HybridBlock.__init__(self)
+        if isinstance(function, str):
+            from ... import np as _np
+
+            function = getattr(_np, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Concatenate(Sequential):
+    """Run children on the same input, concat outputs (reference:
+    contrib Concurrent/HybridConcurrent)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from ... import np as _np
+
+        return _np.concatenate([block(x) for block in self._children.values()],
+                               axis=self._axis)
+
+
+class HybridConcatenate(Concatenate, HybridBlock):
+    def __init__(self, axis=-1):
+        HybridBlock.__init__(self)
+        self._axis = axis

@@ -1,0 +1,243 @@
+"""Convolution and pooling layers.
+
+Reference: python/mxnet/gluon/nn/conv_layers.py -> src/operator/nn/
+convolution.cc / pooling.cc. Convs lower to lax.conv_general_dilated (MXU);
+layouts follow the reference default NCHW — XLA transposes internally to the
+TPU-preferred layout during compilation.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ... import numpy_extension as npx
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D"]
+
+
+def _tup(x, n):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,) * n
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 dtype="float32", transpose=False, output_padding=0, **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._stride = _tup(strides, ndim)
+        self._pad = _tup(padding, ndim)
+        self._dilate = _tup(dilation, ndim)
+        self._groups = groups
+        self._layout = layout
+        self._activation = activation
+        self._transpose = transpose
+        self._adj = _tup(output_padding, ndim)
+        wshape = ((in_channels, channels // groups) + kernel_size) \
+            if transpose else ((channels, in_channels // groups
+                                if in_channels else 0) + kernel_size)
+        self.weight = Parameter(shape=wshape, dtype=dtype,
+                                init=weight_initializer or "xavier",
+                                allow_deferred_init=True)
+        self.bias = Parameter(shape=(channels,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+
+    def _infer(self, x):
+        if self.weight._data is None:
+            c_axis = self._layout.index("C")
+            in_c = x.shape[c_axis]
+            if self._transpose:
+                self.weight.shape = (in_c, self._channels // self._groups) + \
+                    self._kernel
+            else:
+                self.weight.shape = (self._channels, in_c // self._groups) + \
+                    self._kernel
+            self.weight._finish_deferred_init()
+        if self.bias is not None and self.bias._data is None:
+            self.bias._finish_deferred_init()
+
+    def forward(self, x):
+        self._infer(x)
+        bias = self.bias.data() if self.bias is not None else None
+        if self._transpose:
+            out = npx.deconvolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._stride, dilate=self._dilate, pad=self._pad,
+                adj=self._adj, num_filter=self._channels,
+                num_group=self._groups, layout=self._layout)
+        else:
+            out = npx.convolution(
+                x, self.weight.data(), bias, kernel=self._kernel,
+                stride=self._stride, dilate=self._dilate, pad=self._pad,
+                num_filter=self._channels, num_group=self._groups,
+                layout=self._layout)
+        if self._activation is not None:
+            out = npx.activation(out, act_type=self._activation)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel={self._kernel}, stride={self._stride})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, **kwargs)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 **kwargs):
+        super().__init__(channels, _tup(kernel_size, 1), strides, padding,
+                         dilation, groups, layout, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 2), strides, padding,
+                         dilation, groups, layout, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", **kwargs):
+        super().__init__(channels, _tup(kernel_size, 3), strides, padding,
+                         dilation, groups, layout, transpose=True,
+                         output_padding=output_padding, **kwargs)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, global_pool, pool_type,
+                 layout, count_include_pad=True, ceil_mode=False, **kwargs):
+        super().__init__(**kwargs)
+        ndim = len(pool_size) if isinstance(pool_size, tuple) else 1
+        self._kernel = pool_size
+        self._stride = _tup(strides if strides is not None else pool_size,
+                            len(pool_size))
+        self._pad = _tup(padding, len(pool_size))
+        self._global = global_pool
+        self._type = pool_type
+        self._layout = layout
+        self._cip = count_include_pad
+
+    def forward(self, x):
+        return npx.pooling(x, kernel=self._kernel, pool_type=self._type,
+                           stride=self._stride, pad=self._pad,
+                           global_pool=self._global,
+                           count_include_pad=self._cip, layout=self._layout)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._kernel}, "
+                f"stride={self._stride}, pad={self._pad})")
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 **kwargs):
+        super().__init__(_tup(pool_size, 1), strides, padding, False, "max",
+                         layout, **kwargs)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", **kwargs):
+        super().__init__(_tup(pool_size, 2), strides, padding, False, "max",
+                         layout, **kwargs)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", **kwargs):
+        super().__init__(_tup(pool_size, 3), strides, padding, False, "max",
+                         layout, **kwargs)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 count_include_pad=True, **kwargs):
+        super().__init__(_tup(pool_size, 1), strides, padding, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", count_include_pad=True, **kwargs):
+        super().__init__(_tup(pool_size, 2), strides, padding, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", count_include_pad=True, **kwargs):
+        super().__init__(_tup(pool_size, 3), strides, padding, False, "avg",
+                         layout, count_include_pad, **kwargs)
+
+
+class _GlobalPool(_Pool):
+    def __init__(self, ndim, pool_type, layout, **kwargs):
+        super().__init__((1,) * ndim, None, 0, True, pool_type, layout,
+                         **kwargs)
+
+
+class GlobalMaxPool1D(_GlobalPool):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__(1, "max", layout, **kwargs)
+
+
+class GlobalMaxPool2D(_GlobalPool):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__(2, "max", layout, **kwargs)
+
+
+class GlobalMaxPool3D(_GlobalPool):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__(3, "max", layout, **kwargs)
+
+
+class GlobalAvgPool1D(_GlobalPool):
+    def __init__(self, layout="NCW", **kwargs):
+        super().__init__(1, "avg", layout, **kwargs)
+
+
+class GlobalAvgPool2D(_GlobalPool):
+    def __init__(self, layout="NCHW", **kwargs):
+        super().__init__(2, "avg", layout, **kwargs)
+
+
+class GlobalAvgPool3D(_GlobalPool):
+    def __init__(self, layout="NCDHW", **kwargs):
+        super().__init__(3, "avg", layout, **kwargs)

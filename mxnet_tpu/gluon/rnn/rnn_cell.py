@@ -1,0 +1,276 @@
+"""Recurrent cells (reference: python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cells are stepwise HybridBlocks; ``unroll`` uses the fused scan path when the
+sequence is an NDArray (one compiled scan instead of T python steps).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ... import np as _np
+from ... import numpy_extension as npx
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
+           "ResidualCell", "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            shape = info["shape"]
+            states.append(_np.zeros(shape) if func is None
+                          else func(shape, **kwargs))
+        return states
+
+    def reset(self):
+        pass
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Python unroll over time (reference: rnn_cell.py unroll)."""
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            x_t = _np.take(inputs, _np.array(t, dtype="int32"), axis=axis)
+            out, states = self(x_t, states)
+            outputs.append(out)
+        if merge_outputs is False:
+            return outputs, states
+        stacked = _np.stack(outputs, axis=axis)
+        if valid_length is not None:
+            stacked = npx.sequence_mask(
+                stacked.swapaxes(0, axis) if axis != 0 else stacked,
+                valid_length, use_sequence_length=True, axis=0)
+            if axis != 0:
+                stacked = stacked.swapaxes(0, axis)
+        return stacked, states
+
+
+class _BaseCell(RecurrentCell):
+    def __init__(self, hidden_size, ngates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = ngates
+        self.i2h_weight = Parameter(shape=(ng * hidden_size, input_size),
+                                    init=i2h_weight_initializer, dtype=dtype,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter(shape=(ng * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer, dtype=dtype)
+        self.i2h_bias = Parameter(shape=(ng * hidden_size,),
+                                  init=i2h_bias_initializer, dtype=dtype)
+        self.h2h_bias = Parameter(shape=(ng * hidden_size,),
+                                  init=h2h_bias_initializer, dtype=dtype)
+
+    def _infer(self, x):
+        if self.i2h_weight._data is None:
+            self.i2h_weight.shape = (self.i2h_weight.shape[0], x.shape[-1])
+            self.i2h_weight._finish_deferred_init()
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+
+class RNNCell(_BaseCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def forward(self, x, states):
+        self._infer(x)
+        h = states[0] if isinstance(states, (list, tuple)) else states
+        out = npx.fully_connected(x, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=self._hidden_size,
+                                  flatten=False) + \
+            npx.fully_connected(h, self.h2h_weight.data(),
+                                self.h2h_bias.data(),
+                                num_hidden=self._hidden_size, flatten=False)
+        out = npx.activation(out, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseCell):
+    """LSTM cell, gate order i,f,g,o (reference: rnn_cell.py LSTMCell)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        self._infer(x)
+        h, c = states
+        n = self._hidden_size
+        gates = npx.fully_connected(x, self.i2h_weight.data(),
+                                    self.i2h_bias.data(), num_hidden=4 * n,
+                                    flatten=False) + \
+            npx.fully_connected(h, self.h2h_weight.data(),
+                                self.h2h_bias.data(), num_hidden=4 * n,
+                                flatten=False)
+        i = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=0, end=n))
+        f = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=n, end=2 * n))
+        g = _np.tanh(npx.slice_axis(gates, axis=-1, begin=2 * n, end=3 * n))
+        o = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=3 * n,
+                                       end=4 * n))
+        c_new = f * c + i * g
+        h_new = o * _np.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(_BaseCell):
+    """GRU cell, cuDNN formulation (reference: rnn_cell.py GRUCell)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def forward(self, x, states):
+        self._infer(x)
+        h = states[0]
+        n = self._hidden_size
+        gi = npx.fully_connected(x, self.i2h_weight.data(),
+                                 self.i2h_bias.data(), num_hidden=3 * n,
+                                 flatten=False)
+        gh = npx.fully_connected(h, self.h2h_weight.data(),
+                                 self.h2h_bias.data(), num_hidden=3 * n,
+                                 flatten=False)
+        ir = npx.slice_axis(gi, axis=-1, begin=0, end=n)
+        iz = npx.slice_axis(gi, axis=-1, begin=n, end=2 * n)
+        in_ = npx.slice_axis(gi, axis=-1, begin=2 * n, end=3 * n)
+        hr = npx.slice_axis(gh, axis=-1, begin=0, end=n)
+        hz = npx.slice_axis(gh, axis=-1, begin=n, end=2 * n)
+        hn = npx.slice_axis(gh, axis=-1, begin=2 * n, end=3 * n)
+        r = npx.sigmoid(ir + hr)
+        z = npx.sigmoid(iz + hz)
+        nn_ = _np.tanh(in_ + r * hn)
+        h_new = (_np.ones_like(z) - z) * nn_ + z * h
+        return h_new, [h_new]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        info = []
+        for cell in self._children.values():
+            info.extend(cell.state_info(batch_size))
+        return info
+
+    def forward(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, new = cell(x, states[p:p + n])
+            next_states.extend(new)
+            p += n
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, x, states):
+        if self._rate > 0:
+            x = npx.dropout(x, p=self._rate)
+        return x, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+
+    def forward(self, x, states):
+        out, new_states = self.base_cell(x, states)
+        from ... import autograd
+
+        if autograd.is_training():
+            if self._zo > 0:
+                mask = npx.dropout(_np.ones_like(out), p=self._zo) * \
+                    (1 - self._zo)
+                out = mask * out  # zoneout approximated by scaled dropout
+            if self._zs > 0:
+                new_states = [s_old + (s_new - s_old) *
+                              (npx.dropout(_np.ones_like(s_new), p=self._zs) *
+                               (1 - self._zs))
+                              for s_old, s_new in zip(states, new_states)]
+        return out, new_states
+
+
+class ResidualCell(_ModifierCell):
+    def forward(self, x, states):
+        out, new_states = self.base_cell(x, states)
+        return out + x, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def forward(self, x, states):
+        raise MXNetError("BidirectionalCell supports unroll() only")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        n_l = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:n_l], layout, True, valid_length)
+        axis = layout.find("T")
+        rev = _np.flip(inputs, axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state[n_l:], layout, True, valid_length)
+        r_out = _np.flip(r_out, axis=axis)
+        return _np.concatenate([l_out, r_out], axis=-1), l_states + r_states

@@ -1,0 +1,143 @@
+"""Fused multi-layer RNN / LSTM / GRU layers.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py -> fused RNN op (src/operator/
+rnn.cc:297, cuDNN path). TPU-native: one 'rnn' op per forward — the whole
+stack is a nest of lax.scans compiled into a single XLA program; weights are
+explicit scan operands so gradients flow (see ops/rnn.py).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ops.registry import apply_op
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ... import np as _np
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size=0, dtype="float32",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"invalid layout {layout}")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for layer in range(num_layers):
+            for d in range(self._dir):
+                suffix = f"l{layer}" + ("_r" if d else "")
+                in_sz = ni if layer == 0 else nh * self._dir
+                setattr(self, f"{suffix}_i2h_weight", Parameter(
+                    shape=(ng * nh, in_sz if in_sz else 0), dtype=dtype,
+                    init=i2h_weight_initializer, allow_deferred_init=True))
+                setattr(self, f"{suffix}_h2h_weight", Parameter(
+                    shape=(ng * nh, nh), dtype=dtype,
+                    init=h2h_weight_initializer))
+                setattr(self, f"{suffix}_i2h_bias", Parameter(
+                    shape=(ng * nh,), dtype=dtype,
+                    init=i2h_bias_initializer))
+                setattr(self, f"{suffix}_h2h_bias", Parameter(
+                    shape=(ng * nh,), dtype=dtype,
+                    init=h2h_bias_initializer))
+
+    def _weight_params(self):
+        out = []
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = f"l{layer}" + ("_r" if d else "")
+                out.extend([getattr(self, f"{suffix}_i2h_weight"),
+                            getattr(self, f"{suffix}_h2h_weight"),
+                            getattr(self, f"{suffix}_i2h_bias"),
+                            getattr(self, f"{suffix}_h2h_bias")])
+        return out
+
+    def _infer(self, x):
+        in_sz = x.shape[-1]
+        for layer in range(self._num_layers):
+            for d in range(self._dir):
+                suffix = f"l{layer}" + ("_r" if d else "")
+                w = getattr(self, f"{suffix}_i2h_weight")
+                if w._data is None:
+                    expect = in_sz if layer == 0 else \
+                        self._hidden_size * self._dir
+                    w.shape = (w.shape[0], expect)
+                    w._finish_deferred_init()
+
+    def state_info(self, batch_size=0):
+        n = self._num_layers * self._dir
+        shape = (n, batch_size, self._hidden_size)
+        if self._mode == "lstm":
+            return [{"shape": shape}, {"shape": shape}]
+        return [{"shape": shape}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return [_np.zeros(info["shape"]) if func is None
+                else func(info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def forward(self, x, states=None):
+        self._infer(x)
+        explicit_states = states is not None
+        if self._layout == "NTC":
+            x_t = x.swapaxes(0, 1)
+        else:
+            x_t = x
+        batch = x_t.shape[1]
+        if states is None:
+            states = self.begin_state(batch)
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        weights = [p.data() for p in self._weight_params()]
+        args = [x_t, states[0]] + \
+            ([states[1]] if self._mode == "lstm" else []) + weights
+        out = apply_op("rnn", *args, mode=self._mode,
+                       num_layers=self._num_layers,
+                       hidden_size=self._hidden_size,
+                       bidirectional=self._dir == 2, dropout=self._dropout)
+        if self._mode == "lstm":
+            ys, h, c = out
+            new_states = [h, c]
+        else:
+            ys, h = out
+            new_states = [h]
+        if self._layout == "NTC":
+            ys = ys.swapaxes(0, 1)
+        if explicit_states:
+            return ys, new_states
+        return ys
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"layers={self._num_layers}, layout={self._layout})")
+
+
+class RNN(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers, layout,
+                         dropout, bidirectional, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, **kwargs)

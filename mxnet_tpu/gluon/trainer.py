@@ -1,0 +1,172 @@
+"""gluon.Trainer — applies an optimizer to a set of Parameters.
+
+Reference: python/mxnet/gluon/trainer.py (step:~360, _allreduce_grads:407
+pushing grads through KVStore with priority=-i). TPU-native behavior:
+
+- single device: grads are already in the Parameter grad buffers (tape
+  backward); step = fused jitted update per parameter (src/operator/
+  optimizer_op.cc analog).
+- kvstore='device'/'dist_sync': grads are allreduced through the KVStore
+  facade (XLA add / cross-host collective) before the update — preserving the
+  reference's update_on_kvstore semantics when enabled.
+- the high-throughput path (whole train step as one SPMD program) is
+  mxnet_tpu.parallel.Learner; Trainer is the script-parity path.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .parameter import Parameter
+from .. import optimizer as opt_mod
+from .. import kvstore as kvs_mod
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore=None,
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, dict):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a dict or list of Parameters")
+        self._params = []
+        self._params_name2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p!r}")
+            self._params.append(p)
+            self._params_name2idx[p.name] = i
+            p._trainer = self
+        optimizer_params = optimizer_params or {}
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer,
+                                             param_dict=param_dict,
+                                             **optimizer_params)
+        self._states = [None] * len(self._params)
+        self._kvstore = None
+        self._update_on_kvstore = update_on_kvstore
+        self._kv_initialized = False
+        self._kvstore_spec = kvstore
+        self._scale = self._optimizer.rescale_grad
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- kvstore ------------------------------------------------------------
+    def _init_kvstore(self):
+        spec = self._kvstore_spec
+        if spec is None or spec in ("local", "device", "nccl") and \
+                self._update_on_kvstore is not True:
+            # single-worker fast path: no store needed
+            self._kvstore = kvs_mod.create(spec) if spec else None
+            self._kv_initialized = True
+            return
+        self._kvstore = spec if isinstance(spec, kvs_mod.KVStoreBase) \
+            else kvs_mod.create(spec)
+        if self._update_on_kvstore:
+            self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def kvstore(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        return self._kvstore
+
+    # -- the step -----------------------------------------------------------
+    def allreduce_grads(self):
+        """Explicit grad allreduce (multi-worker)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._kvstore is None or self._kvstore.num_workers == 1:
+            return
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            g = p.grad()
+            self._kvstore.pushpull(i, g, out=g, priority=-i)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Rescale grads by 1/batch_size, allreduce, update.
+
+        Reference: trainer.py step -> _allreduce_grads -> _update.
+        """
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if self._kvstore is not None and self._update_on_kvstore:
+            # optimizer runs on the store (reference update_on_kvstore):
+            # pushpull applies the store-side updater and writes the new
+            # weight back — works with any worker count
+            for i, p in enumerate(self._params):
+                if p.grad_req == "null":
+                    continue
+                self._kvstore.pushpull(i, p.grad(), out=p.data(),
+                                       priority=-i)
+            return
+        if self._kvstore is not None and self._kvstore.num_workers > 1:
+            self.allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(f"parameter {p.name} not initialized")
+            if self._update_on_kvstore and self._kvstore is not None:
+                # optimizer ran on the store during pushpull
+                continue
+            if self._states[i] is None:
+                self._states[i] = \
+                    self._optimizer.create_state_multi_precision(i, p.data())
+            self._optimizer.update(i, p.data(), p.grad(), self._states[i])
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Apply updates without allreduce (manual grad management)."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    # -- checkpoint ---------------------------------------------------------
+    def save_states(self, fname):
+        """Reference: trainer.py:482."""
+        import pickle
+
+        payload = []
+        for st in self._states:
+            if st is None:
+                payload.append(None)
+            else:
+                payload.append({k: v.asnumpy() for k, v in st.items()})
+        with open(fname, "wb") as f:
+            pickle.dump({"states": payload,
+                         "num_update": self._optimizer.num_update,
+                         "index_count": self._optimizer._index_update_count},
+                        f)
+
+    def load_states(self, fname):
+        import pickle
+        from ..ndarray.ndarray import NDArray
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self._states = [None if st is None else
+                        {k: NDArray(v) for k, v in st.items()}
+                        for st in payload["states"]]
+        self._optimizer.num_update = payload["num_update"]
+        self._optimizer._index_update_count = payload["index_count"]

@@ -1,0 +1,199 @@
+"""Weight initializers (reference: python/mxnet/initializer.py:56-694).
+
+Samplers draw from the framework's global PRNG (mx.random), so
+``mx.random.seed`` makes initialization reproducible.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray
+from . import random as _random
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "register", "create"]
+
+_registry = Registry("initializer")
+register = _registry.register
+
+
+def create(init, **kwargs):
+    if init is None:
+        return Uniform(0.07)
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        return _registry.get(init)(**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class Initializer:
+    """Base initializer; subclasses implement _init_weight(name, arr)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr=None):
+        if arr is None:  # called as init(array) in some legacy code
+            arr, name = name, ""
+        self.init_array(name or "", arr)
+
+    def init_array(self, name: str, arr: NDArray):
+        name = name.lower()
+        if name.endswith("bias") or name.endswith("beta") or \
+                name.endswith("running_mean") or name.endswith("moving_mean"):
+            arr._set_data(jnp.zeros(arr.shape, arr.dtype))
+        elif name.endswith("gamma") or name.endswith("running_var") or \
+                name.endswith("moving_var"):
+            arr._set_data(jnp.ones(arr.shape, arr.dtype))
+        else:
+            self._init_weight(name, arr)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        arr._set_data(jnp.zeros(arr.shape, arr.dtype))
+
+
+_registry.alias("zeros", "zero")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        arr._set_data(jnp.ones(arr.shape, arr.dtype))
+
+
+_registry.alias("ones", "one")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        v = self.value
+        if isinstance(v, NDArray):
+            arr._set_data(v._data.astype(arr.dtype))
+        else:
+            arr._set_data(jnp.full(arr.shape, v, arr.dtype))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        data = jax.random.uniform(_random._next_key(), arr.shape,
+                                  minval=-self.scale, maxval=self.scale)
+        arr._set_data(data.astype(arr.dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        data = jax.random.normal(_random._next_key(), arr.shape) * self.sigma
+        arr._set_data(data.astype(arr.dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        data = jax.random.orthogonal(_random._next_key(), max(nout, nin))
+        data = data[:nout, :nin] * self.scale
+        arr._set_data(data.reshape(arr.shape).astype(arr.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init (reference: initializer.py Xavier; gluon default for convs)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise MXNetError(f"Xavier requires ndim>=2, got shape {shape} "
+                             f"for {name}")
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        factor = {"avg": (fan_in + fan_out) / 2.0,
+                  "in": fan_in, "out": fan_out}[self.factor_type]
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            data = jax.random.uniform(_random._next_key(), shape,
+                                      minval=-scale, maxval=scale)
+        else:
+            data = jax.random.normal(_random._next_key(), shape) * scale
+        arr._set_data(data.astype(arr.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = onp.zeros(arr.shape, dtype="float32")
+        shape = arr.shape
+        f = shape[3] / 2.0
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight).astype(arr.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = onp.zeros(arr.shape, dtype="float32")
+        n = arr.shape[0] // 4
+        b[n:2 * n] = self.forget_bias  # gate order: i, f, g, o
+        arr._set_data(jnp.asarray(b).astype(arr.dtype))

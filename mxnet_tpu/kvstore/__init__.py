@@ -1,0 +1,5 @@
+"""KVStore package (reference: python/mxnet/kvstore/)."""
+from .base import KVStoreBase
+from .kvstore import KVStore, create
+
+__all__ = ["KVStoreBase", "KVStore", "create"]

@@ -1,0 +1,76 @@
+"""KVStore base interface + backend registry.
+
+Reference: python/mxnet/kvstore/base.py (KVStoreBase.register:74, the
+pluggable-backend pattern that hosts Horovod/BytePS). The TPU build keeps the
+registry so alternative collective backends can slot in; the built-in backends
+map onto XLA collectives instead of NCCL/ps-lite (SURVEY §5.8).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["KVStoreBase"]
+
+
+class KVStoreBase:
+    """Abstract key-value store for parameter synchronization."""
+
+    OPTIMIZER = "optimizer"
+    _kv_registry: dict[str, type] = {}
+
+    # -- registry -----------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase._kv_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def get_kvstore_class(name: str):
+        try:
+            return KVStoreBase._kv_registry[name.lower()]
+        except KeyError:
+            raise MXNetError(
+                f"kvstore type '{name}' is not registered; known: "
+                f"{sorted(KVStoreBase._kv_registry)}") from None
+
+    # -- interface (reference include/mxnet/kvstore.h:59) -------------------
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    @staticmethod
+    def is_capable(capability):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    @property
+    def type(self):
+        return type(self).__name__.lower()
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError

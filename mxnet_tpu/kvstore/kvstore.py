@@ -1,0 +1,245 @@
+"""KVStore backends on XLA collectives.
+
+Reference: src/kvstore/ (N11 in SURVEY §2.1) — local/device GPU allreduce
+(comm.h), NCCL (kvstore_nccl.h), dist_sync parameter server over ps-lite
+(kvstore_dist.h / kvstore_dist_server.h). TPU-native mapping (SURVEY §5.8):
+
+- ``local`` / ``device`` / ``nccl``: single-process reduction. Per-device
+  values are summed on the accelerator (XLA add; with one TPU chip the values
+  are usually already co-located). The heavy-duty data-parallel path is
+  ``mxnet_tpu.parallel`` (pjit over a Mesh with psum on ICI) — this facade
+  exists for Trainer/script parity.
+- ``dist_sync`` / ``dist_device_sync``: multi-process via ``jax.distributed``;
+  pushpull performs a cross-host allreduce (DCN/ICI collectives), replacing
+  the ps-lite push/pull with merged updates (kvstore_dist_server.h:346).
+- ``dist_async``: no TPU analog (documented unsupported, SURVEY §7).
+
+Semantics preserved (include/mxnet/kvstore.h): Init rank-0 wins, Push sums
+multi-device values, PushPull fuses both, optional optimizer-on-store
+(``update_on_kvstore``), rank/size/barrier.
+"""
+from __future__ import annotations
+
+import pickle
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+
+__all__ = ["KVStore", "create"]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _keys_vals(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """Single-process store ('local'/'device'): sum-reduce on device."""
+
+    def __init__(self, name="local"):
+        self._name = name
+        self._store: dict = {}
+        self._updater = None
+        self._optimizer = None
+
+    @property
+    def type(self):
+        return self._name
+
+    @staticmethod
+    def is_capable(capability):
+        return capability in ("optimizer", "init")
+
+    # -- core ---------------------------------------------------------------
+    def init(self, key, value):
+        keys, vals = _keys_vals(key, value)
+        for k, v in zip(keys, vals):
+            self._store[k] = NDArray(_as_list(v)[0]._data)
+
+    def _reduce(self, vlist):
+        """Sum values (possibly one per device) into one array.
+
+        Reference: CommCPU/CommDevice::Reduce (src/kvstore/comm.h:104).
+        """
+        vlist = _as_list(vlist)
+        acc = vlist[0]._data
+        for v in vlist[1:]:
+            acc = acc + v._data
+        return acc
+
+    def push(self, key, value, priority=0):
+        keys, vals = _keys_vals(key, value)
+        for k, v in zip(keys, vals):
+            red = self._reduce(v)
+            if self._updater is not None:
+                if k not in self._store:
+                    self._store[k] = NDArray(red)
+                else:
+                    self._updater(k, NDArray(red), self._store[k])
+            else:
+                self._store[k] = NDArray(red)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _keys_vals(key, out)
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"kvstore key {k!r} was never init'd/pushed")
+            src = self._store[k]
+            for dst in _as_list(o):
+                dst._set_data(src.as_in_ctx(dst.ctx)._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused allreduce (reference: kvstore.h:237 PushPull)."""
+        keys, vals = _keys_vals(key, value)
+        outs = [None] * len(keys) if out is None else _keys_vals(key, out)[1]
+        for k, v, o in zip(keys, vals, outs):
+            red = self._reduce(v)
+            red = self._global_reduce(red)
+            if self._updater is not None and o is not None:
+                if k not in self._store:
+                    self._store[k] = NDArray(_as_list(o)[0]._data)
+                self._updater(k, NDArray(red), self._store[k])
+                red = self._store[k]._data
+            if o is not None:
+                for dst in _as_list(o):
+                    dst._set_data(red)
+            else:
+                self._store[k] = NDArray(red)
+
+    def _global_reduce(self, data):
+        return data  # single process
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Dense emulation of PullRowSparse (reference kvstore.h:264)."""
+        self.pull(key, out, priority)
+
+    # -- optimizer-on-store (reference: update_on_kvstore) -------------------
+    def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+
+        self._optimizer = optimizer
+        self._updater = get_updater(optimizer)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # -- topology -----------------------------------------------------------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        from .. import engine
+
+        engine.wait_all()
+
+    def __repr__(self):
+        return f"KVStore(type={self.type}, rank={self.rank}/{self.num_workers})"
+
+
+@KVStoreBase.register
+class Device(KVStore):
+    def __init__(self):
+        super().__init__("device")
+
+
+@KVStoreBase.register
+class Local(KVStore):
+    def __init__(self):
+        super().__init__("local")
+
+
+@KVStoreBase.register
+class Nccl(KVStore):
+    """Alias kept so kvstore='nccl' scripts run; reduction is XLA, not NCCL."""
+
+    def __init__(self):
+        super().__init__("nccl")
+
+
+@KVStoreBase.register
+class Dist_Sync(KVStore):
+    """Multi-host synchronous data parallelism over jax.distributed.
+
+    Replaces the ps-lite worker/server processes (kvstore_dist.h): every
+    process contributes its local reduction; the global sum rides XLA
+    collectives (ICI within a slice, DCN across slices).
+    """
+
+    def __init__(self, name="dist_sync"):
+        super().__init__(name)
+        import jax
+
+        self._nproc = jax.process_count()
+        self._rank = jax.process_index()
+
+    def _global_reduce(self, data):
+        if self._nproc == 1:
+            return data
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(data)
+        return gathered.sum(axis=0)
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._nproc
+
+    def barrier(self):
+        super().barrier()
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+@KVStoreBase.register
+class Dist_Device_Sync(Dist_Sync):
+    def __init__(self):
+        super().__init__("dist_device_sync")
+
+
+def create(name="local") -> KVStoreBase:
+    """Factory (reference: KVStore::Create, src/kvstore/kvstore.cc:42-80)."""
+    if not isinstance(name, str):
+        raise MXNetError("kvstore name must be a string")
+    name = name.lower()
+    if name == "dist_async":
+        raise MXNetError(
+            "dist_async has no TPU analog (synchronous XLA collectives); "
+            "use dist_sync — see SURVEY.md §2.2")
+    aliases = {"local": "local", "device": "device", "nccl": "nccl",
+               "dist_sync": "dist_sync", "dist_device_sync":
+               "dist_device_sync", "dist": "dist_sync",
+               "horovod": "dist_sync", "byteps": "dist_sync"}
+    if name not in aliases:
+        raise MXNetError(f"unknown kvstore type {name!r}")
+    return KVStoreBase.get_kvstore_class(aliases[name])()

@@ -1,0 +1,346 @@
+"""Evaluation metrics (reference: python/mxnet/gluon/metric.py — EvalMetric:68,
+Accuracy:370, F1:727, Perplexity:1433, registry create:195)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "Perplexity",
+           "PearsonCorrelation", "Loss", "create"]
+
+_registry = Registry("metric")
+register = _registry.register
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        m = CompositeEvalMetric()
+        for child in metric:
+            m.add(create(child, *args, **kwargs))
+        return m
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    return _registry.get(metric)(*args, **kwargs)
+
+
+def _np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def update_dict(self, label, pred):
+        self.update(list(label.values()), list(pred.values()))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+
+def _as_lists(labels, preds):
+    if isinstance(labels, (NDArray, onp.ndarray)):
+        labels = [labels]
+    if isinstance(preds, (NDArray, onp.ndarray)):
+        preds = [preds]
+    if len(labels) != len(preds):
+        raise MXNetError(f"labels/preds length mismatch "
+                         f"{len(labels)} vs {len(preds)}")
+    return labels, preds
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label), _np(pred)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(self.axis)
+            pred = pred.astype("int32").ravel()
+            label = label.astype("int32").ravel()
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label), _np(pred)
+            idx = onp.argsort(-pred, axis=-1)[..., : self.top_k]
+            hit = (idx == label[..., None].astype("int64")).any(-1)
+            self.sum_metric += float(hit.sum())
+            self.num_inst += label.size
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", threshold=0.5, **kwargs):
+        self.average = average
+        self.threshold = threshold
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self.tp = self.fp = self.fn = 0.0
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label).ravel(), _np(pred)
+            if pred.ndim > 1 and pred.shape[-1] == 2:
+                pred = pred[..., 1].ravel() > self.threshold
+            else:
+                pred = pred.ravel() > self.threshold
+            label = label.astype(bool)
+            self.tp += float((pred & label).sum())
+            self.fp += float((pred & ~label).sum())
+            self.fn += float((~pred & label).sum())
+            self.num_inst += 1
+
+    def get(self):
+        prec = self.tp / (self.tp + self.fp) if self.tp + self.fp else 0.0
+        rec = self.tp / (self.tp + self.fn) if self.tp + self.fn else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        return self.name, f1
+
+
+@register
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self.tp = self.fp = self.fn = self.tn = 0.0
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _np(label).ravel().astype(bool)
+            pred = _np(pred)
+            if pred.ndim > 1 and pred.shape[-1] == 2:
+                pred = pred[..., 1].ravel() > 0.5
+            else:
+                pred = pred.ravel() > 0.5
+            self.tp += float((pred & label).sum())
+            self.fp += float((pred & ~label).sum())
+            self.fn += float((~pred & label).sum())
+            self.tn += float((~pred & ~label).sum())
+            self.num_inst += 1
+
+    def get(self):
+        import math
+
+        denom = math.sqrt((self.tp + self.fp) * (self.tp + self.fn) *
+                          (self.tn + self.fp) * (self.tn + self.fn))
+        mcc = ((self.tp * self.tn - self.fp * self.fn) / denom) if denom \
+            else 0.0
+        return self.name, mcc
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label), _np(pred)
+            self.sum_metric += float(onp.abs(label - pred.reshape(
+                label.shape)).mean())
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label), _np(pred)
+            self.sum_metric += float(((label - pred.reshape(
+                label.shape)) ** 2).mean())
+            self.num_inst += 1
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def get(self):
+        name, value = super().get()
+        return name, float(onp.sqrt(value))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _np(label).ravel().astype("int64")
+            pred = _np(pred)
+            prob = pred[onp.arange(label.shape[0]), label]
+            self.sum_metric += float((-onp.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ignore_label = ignore_label
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _np(label).ravel().astype("int64")
+            pred = _np(pred).reshape(-1, _np(pred).shape[-1])
+            prob = pred[onp.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = label == self.ignore_label
+                prob = prob[~ignore]
+            self.sum_metric += float(-onp.log(prob + self.eps).sum())
+            self.num_inst += prob.shape[0]
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(onp.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            self._labels.append(_np(label).ravel())
+            self._preds.append(_np(pred).ravel())
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return self.name, float("nan")
+        x = onp.concatenate(self._labels)
+        y = onp.concatenate(self._preds)
+        return self.name, float(onp.corrcoef(x, y)[0, 1])
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        if isinstance(preds, (NDArray, onp.ndarray)):
+            preds = [preds]
+        for pred in preds:
+            loss = _np(pred)
+            self.sum_metric += float(loss.sum())
+            self.num_inst += loss.size
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            val = self._feval(_np(label), _np(pred))
+            if isinstance(val, tuple):
+                s, n = val
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += val
+                self.num_inst += 1
+
+
+def np_metric(name=None, **kwargs):
+    def decorator(f):
+        return CustomMetric(f, name or f.__name__, **kwargs)
+
+    return decorator

@@ -1,0 +1,8 @@
+"""mxnet_tpu.models — flat access to the model zoo.
+
+Alias package so ``from mxnet_tpu.models import resnet50_v1`` works alongside
+the reference-compatible ``gluon.model_zoo.vision`` path.
+"""
+from ..gluon.model_zoo.vision import *  # noqa: F401,F403
+from ..gluon.model_zoo.vision import get_model  # noqa: F401
+from ..gluon.model_zoo.vision.mlp import MLP, mlp  # noqa: F401

@@ -1,0 +1,171 @@
+"""mx.nd — legacy NDArray namespace (compatibility layer).
+
+Reference: python/mxnet/ndarray/ndarray.py (22.9k LoC of generated op
+wrappers). This framework has ONE array type; the legacy namespace adapts
+legacy call conventions (``dim`` instead of ``axis``, CamelCase op names,
+``mx.nd.save/load`` binary containers) onto the numpy surface. New code should
+use ``mx.np``.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+from . import numpy as _np
+from . import numpy_extension as _npx
+from . import random  # noqa: F401
+from .engine import wait_all as waitall
+
+# re-export the numpy surface under legacy names
+zeros = _np.zeros
+ones = _np.ones
+full = _np.full
+arange = _np.arange
+empty = _np.empty
+eye = _np.eye
+zeros_like = _np.zeros_like
+ones_like = _np.ones_like
+add = _np.add
+subtract = _np.subtract
+multiply = _np.multiply
+divide = _np.true_divide
+power = _np.power
+maximum = _np.maximum
+minimum = _np.minimum
+exp = _np.exp
+log = _np.log
+sqrt = _np.sqrt
+square = _np.square
+abs = _np.abs
+sign = _np.sign
+sin = _np.sin
+cos = _np.cos
+tanh = _np.tanh
+sigmoid = _npx.sigmoid
+relu = _npx.relu
+dot = _np.dot
+batch_dot = None  # set below
+sum = _np.sum
+mean = _np.mean
+max = _np.max
+min = _np.min
+argmax = _np.argmax
+argmin = _np.argmin
+clip = _np.clip
+where = _np.where
+stack = _np.stack
+split = _np.split
+take = _np.take
+one_hot = _np.one_hot
+pick = _np.pick
+topk = _np.topk
+sort = _np.sort
+argsort = _np.argsort
+expand_dims = _np.expand_dims
+squeeze = _np.squeeze
+transpose = _np.transpose
+reshape = _np.reshape
+tile = _np.tile
+repeat = _np.repeat
+flip = _np.flip
+norm = _np.linalg.norm
+softmax = _npx.softmax
+log_softmax = _npx.log_softmax
+SequenceMask = _npx.sequence_mask
+SequenceLast = _npx.sequence_last
+SequenceReverse = _npx.sequence_reverse
+Activation = _npx.activation
+FullyConnected = _npx.fully_connected
+Convolution = _npx.convolution
+Pooling = _npx.pooling
+Dropout = _npx.dropout
+Embedding = _npx.embedding
+LeakyReLU = _npx.leaky_relu
+
+
+def concat(*data, dim=1):
+    """Legacy concat uses ``dim`` (reference: nd.concat)."""
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = data[0]
+    return _np.concatenate(list(data), axis=dim)
+
+
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = lhs.transpose((0, 2, 1)) if transpose_a else lhs
+    b = rhs.transpose((0, 2, 1)) if transpose_b else rhs
+    return _np.matmul(a, b)
+
+
+def flatten(data):
+    return data.reshape((data.shape[0], -1))
+
+
+def slice_axis(data, axis, begin, end):
+    return _npx.slice_axis(data, axis=axis, begin=begin, end=end)
+
+
+def broadcast_add(a, b):
+    return _np.add(a, b)
+
+
+broadcast_plus = broadcast_add
+
+
+def broadcast_sub(a, b):
+    return _np.subtract(a, b)
+
+
+def broadcast_mul(a, b):
+    return _np.multiply(a, b)
+
+
+def broadcast_div(a, b):
+    return _np.true_divide(a, b)
+
+
+def broadcast_maximum(a, b):
+    return _np.maximum(a, b)
+
+
+def broadcast_minimum(a, b):
+    return _np.minimum(a, b)
+
+
+def elemwise_add(a, b):
+    return _np.add(a, b)
+
+
+def elemwise_sub(a, b):
+    return _np.subtract(a, b)
+
+
+def elemwise_mul(a, b):
+    return _np.multiply(a, b)
+
+
+# ---------------------------------------------------------------------------
+# save / load — reference: NDArray::Save/Load (src/ndarray/ndarray.cc:1729,
+# 1852) + python/mxnet/ndarray/utils.py:149,222. We use the .npz container
+# (same role; portable numpy interchange like src/serialization/cnpy.cc).
+# ---------------------------------------------------------------------------
+def save(fname, data):
+    if isinstance(data, NDArray):
+        _onp.savez(fname, __single__=data.asnumpy())
+    elif isinstance(data, list):
+        _onp.savez(fname, **{f"__list__{i}": d.asnumpy()
+                             for i, d in enumerate(data)})
+    elif isinstance(data, dict):
+        _onp.savez(fname, **{k: v.asnumpy() for k, v in data.items()})
+    else:
+        raise MXNetError(f"cannot save {type(data)}")
+
+
+def load(fname):
+    with _onp.load(fname) as z:
+        keys = list(z.keys())
+        if keys == ["__single__"]:
+            return NDArray(z["__single__"])
+        if keys and keys[0].startswith("__list__"):
+            return [NDArray(z[f"__list__{i}"]) for i in range(len(keys))]
+        return {k: NDArray(z[k]) for k in keys}

@@ -1,0 +1,4 @@
+"""NDArray package. ``mx.nd`` legacy namespace lives in .legacy."""
+from .ndarray import NDArray, array, from_jax
+
+__all__ = ["NDArray", "array", "from_jax"]

@@ -1,0 +1,674 @@
+"""mx.np — NumPy-compatible array API executing on TPU via XLA.
+
+TPU-native equivalent of the reference's numpy surface
+(python/mxnet/numpy/multiarray.py + python/mxnet/ndarray/numpy/_op.py, backed
+by src/operator/numpy/* — 128 files of C++/CUDA kernels). Every function here
+funnels through ops.registry.invoke (autograd- and trace-aware); kernels are
+XLA lowerings registered in mxnet_tpu.ops.
+
+Functions with data-dependent output shapes (unique, nonzero, boolean-mask
+compress) cannot compile to static XLA programs; they execute eagerly with a
+host round-trip, mirroring the reference's dynamic-shape escape hatch
+(SetShapeFromChunk, src/imperative/imperative.cc:123). Bounded variants
+(flatnonzero with ``size=``) are provided for compiled code.
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..base import canonical_dtype as _canon
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, array
+from ..ops.registry import apply_op as _op
+from ..ops import indexing as _indexing
+from .. import random  # noqa: F401 — mx.np.random
+from . import linalg  # noqa: F401
+
+ndarray = NDArray
+
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+
+float32 = _onp.float32
+float64 = _onp.float64
+float16 = _onp.float16
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+uint16 = _onp.uint16
+uint32 = _onp.uint32
+uint64 = _onp.uint64
+bool_ = _onp.bool_
+
+
+def _as_nd(x):
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(x) if not _onp.isscalar(x) else x
+
+
+def _both_nd(x1, x2):
+    # at least one operand must become an NDArray for dispatch
+    if not isinstance(x1, NDArray) and not isinstance(x2, NDArray):
+        x1 = array(x1)
+    return _as_nd(x1), _as_nd(x2)
+
+
+# -- generated wrappers ------------------------------------------------------
+_UNARY_FUNCS = [
+    "abs", "absolute", "negative", "sign", "exp", "expm1", "log", "log2",
+    "log10", "log1p", "sqrt", "cbrt", "square", "reciprocal", "sin", "cos",
+    "tan", "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh",
+    "arccosh", "arctanh", "floor", "ceil", "trunc", "rint", "fix", "invert",
+    "logical_not", "isnan", "isinf", "isfinite", "isposinf", "isneginf",
+    "degrees", "radians", "conj", "real", "imag", "angle", "atleast_1d",
+    "atleast_2d", "atleast_3d",
+]
+_ALIAS = {"absolute": "abs"}
+
+_BINARY_FUNCS = [
+    "add", "subtract", "multiply", "true_divide", "divide", "floor_divide",
+    "mod", "fmod", "remainder", "power", "maximum", "minimum", "fmax", "fmin",
+    "hypot", "arctan2", "logaddexp", "equal", "not_equal", "less",
+    "less_equal", "greater", "greater_equal", "logical_and", "logical_or",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor", "left_shift",
+    "right_shift", "matmul", "dot", "inner", "outer", "vdot", "kron",
+    "copysign", "gcd", "lcm", "ldexp", "nextafter",
+]
+_BALIAS = {"divide": "true_divide", "remainder": "mod"}
+
+
+def _def_unary(name):
+    opname = _ALIAS.get(name, name)
+
+    def f(x, out=None, **kw):
+        return _op(opname, _as_nd(x), out=out)
+
+    f.__name__ = name
+    return f
+
+
+def _def_binary(name):
+    opname = _BALIAS.get(name, name)
+
+    def f(x1, x2, out=None, **kw):
+        a, b = _both_nd(x1, x2)
+        return _op(opname, a, b, out=out)
+
+    f.__name__ = name
+    return f
+
+
+for _n in _UNARY_FUNCS:
+    globals()[_n] = _def_unary(_n)
+for _n in _BINARY_FUNCS:
+    globals()[_n] = _def_binary(_n)
+
+erf = _def_unary("erf")
+erfinv = _def_unary("erfinv")
+gamma = _def_unary("gamma")
+gammaln = _def_unary("gammaln")
+
+
+# -- reductions --------------------------------------------------------------
+def _red(name, has_dtype=True, has_ddof=False):
+    def f(a, axis=None, dtype=None, out=None, keepdims=False, ddof=0, **kw):
+        attrs = {"axis": _ax(axis), "keepdims": keepdims}
+        if has_dtype and dtype is not None:
+            attrs["dtype"] = str(_canon(dtype))
+        if has_ddof:
+            attrs["ddof"] = ddof
+        return _op(name, _as_nd(a), out=out, **attrs)
+
+    f.__name__ = name
+    return f
+
+
+def _ax(axis):
+    return tuple(axis) if isinstance(axis, list) else axis
+
+
+sum = _red("sum")
+mean = _red("mean")
+prod = _red("prod")
+std = _red("std", has_ddof=True)
+var = _red("var", has_ddof=True)
+nansum = _red("nansum")
+nanmean = _red("nanmean")
+
+
+def _red_nodtype(name):
+    def f(a, axis=None, out=None, keepdims=False, **kw):
+        return _op(name, _as_nd(a), axis=_ax(axis), keepdims=keepdims, out=out)
+
+    f.__name__ = name
+    return f
+
+
+max = _red_nodtype("max")
+min = _red_nodtype("min")
+amax = max
+amin = min
+nanmax = _red_nodtype("nanmax")
+nanmin = _red_nodtype("nanmin")
+all = _red_nodtype("all")
+any = _red_nodtype("any")
+median = _red_nodtype("median")
+logsumexp = _red_nodtype("logsumexp")
+
+
+def argmax(a, axis=None, out=None, keepdims=False):
+    return _op("argmax", _as_nd(a), axis=axis, keepdims=keepdims, out=out)
+
+
+def argmin(a, axis=None, out=None, keepdims=False):
+    return _op("argmin", _as_nd(a), axis=axis, keepdims=keepdims, out=out)
+
+
+def cumsum(a, axis=None, dtype=None, out=None):
+    return _op("cumsum", _as_nd(a), axis=axis,
+               dtype=None if dtype is None else str(_canon(dtype)), out=out)
+
+
+def cumprod(a, axis=None, dtype=None, out=None):
+    return _op("cumprod", _as_nd(a), axis=axis,
+               dtype=None if dtype is None else str(_canon(dtype)), out=out)
+
+
+def average(a, axis=None, weights=None, returned=False):
+    if weights is None:
+        return mean(a, axis=axis)
+    return _op("average", _as_nd(a), _as_nd(weights), axis=_ax(axis))
+
+
+def trace(a, offset=0, axis1=0, axis2=1):
+    return _op("trace", _as_nd(a), offset=offset, axis1=axis1, axis2=axis2)
+
+
+# -- shape manipulation ------------------------------------------------------
+def reshape(a, newshape, order="C"):
+    return _op("reshape", _as_nd(a), newshape=tuple(newshape)
+               if isinstance(newshape, (list, tuple)) else newshape)
+
+
+def transpose(a, axes=None):
+    return _op("transpose", _as_nd(a), axes=tuple(axes) if axes else None)
+
+
+def swapaxes(a, axis1, axis2):
+    return _op("swapaxes", _as_nd(a), axis1=axis1, axis2=axis2)
+
+
+def moveaxis(a, source, destination):
+    return _op("moveaxis", _as_nd(a),
+               source=tuple(source) if isinstance(source, (list, tuple))
+               else source,
+               destination=tuple(destination)
+               if isinstance(destination, (list, tuple)) else destination)
+
+
+def squeeze(a, axis=None):
+    return _op("squeeze", _as_nd(a), axis=axis)
+
+
+def expand_dims(a, axis):
+    return _op("expand_dims", _as_nd(a), axis=axis)
+
+
+def broadcast_to(a, shape):
+    return _op("broadcast_to", _as_nd(a), shape=tuple(shape))
+
+
+def broadcast_arrays(*args):
+    shape = _onp.broadcast_shapes(*[a.shape for a in args])
+    return [broadcast_to(a, shape) for a in args]
+
+
+def tile(a, reps):
+    return _op("tile", _as_nd(a), reps=tuple(reps)
+               if isinstance(reps, (list, tuple)) else reps)
+
+
+def repeat(a, repeats, axis=None):
+    return _op("repeat", _as_nd(a), repeats=repeats, axis=axis)
+
+
+def flip(a, axis=None):
+    return _op("flip", _as_nd(a), axis=axis)
+
+
+def flipud(a):
+    return flip(a, 0)
+
+
+def fliplr(a):
+    return flip(a, 1)
+
+
+def roll(a, shift, axis=None):
+    return _op("roll", _as_nd(a), shift=tuple(shift)
+               if isinstance(shift, (list, tuple)) else shift,
+               axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis)
+
+
+def rot90(a, k=1, axes=(0, 1)):
+    return _op("rot90", _as_nd(a), k=k, axes=tuple(axes))
+
+
+def ravel(a, order="C"):
+    return reshape(a, (-1,))
+
+
+def concatenate(seq, axis=0, out=None):
+    return _op("concatenate", *[_as_nd(s) for s in seq], axis=axis, out=out)
+
+
+concat = concatenate
+
+
+def stack(seq, axis=0, out=None):
+    return _op("stack", *[_as_nd(s) for s in seq], axis=axis, out=out)
+
+
+def vstack(seq):
+    return concatenate([atleast_2d(s) for s in seq], axis=0)
+
+
+def hstack(seq):
+    seq = [_as_nd(s) for s in seq]
+    if seq[0].ndim == 1:
+        return concatenate(seq, axis=0)
+    return concatenate(seq, axis=1)
+
+
+def dstack(seq):
+    return concatenate([atleast_3d(s) for s in seq], axis=2)
+
+
+def column_stack(seq):
+    seq = [_as_nd(s) for s in seq]
+    seq = [s if s.ndim > 1 else s.reshape((-1, 1)) for s in seq]
+    return concatenate(seq, axis=1)
+
+
+def split(ary, indices_or_sections, axis=0):
+    ios = indices_or_sections
+    ios = tuple(ios) if isinstance(ios, (list, tuple)) else ios
+    out = _op("split", _as_nd(ary), indices_or_sections=ios, axis=axis)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    ios = indices_or_sections
+    ios = tuple(ios) if isinstance(ios, (list, tuple)) else ios
+    out = _op("array_split", _as_nd(ary), indices_or_sections=ios, axis=axis)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def vsplit(ary, ios):
+    return split(ary, ios, 0)
+
+
+def hsplit(ary, ios):
+    return split(ary, ios, 1)
+
+
+def dsplit(ary, ios):
+    return split(ary, ios, 2)
+
+
+def pad(array_, pad_width, mode="constant", constant_values=0, **kw):
+    pw = pad_width
+    if isinstance(pw, (list, tuple)):
+        pw = tuple(tuple(p) if isinstance(p, (list, tuple)) else p for p in pw)
+    return _op("pad", _as_nd(array_), pad_width=pw, mode=mode,
+               constant_values=constant_values)
+
+
+def clip(a, a_min=None, a_max=None, out=None):
+    return _op("clip", _as_nd(a), a_min=a_min, a_max=a_max, out=out)
+
+
+def round(a, decimals=0, out=None):
+    return _op("round", _as_nd(a), decimals=decimals, out=out)
+
+
+around = round
+round_ = round
+
+
+def diag(v, k=0):
+    return _op("diag", _as_nd(v), k=k)
+
+
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    return _op("diagonal", _as_nd(a), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def tril(m, k=0):
+    return _op("tril", _as_nd(m), k=k)
+
+
+def triu(m, k=0):
+    return _op("triu", _as_nd(m), k=k)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition)
+    a, b = _both_nd(x, y)
+    return _op("where", _as_nd(condition), a, b)
+
+
+def sort(a, axis=-1):
+    return _op("sort", _as_nd(a), axis=axis)
+
+
+def argsort(a, axis=-1):
+    return _op("argsort", _as_nd(a), axis=axis)
+
+
+def searchsorted(a, v, side="left"):
+    return _op("searchsorted", _as_nd(a), _as_nd(v), side=side)
+
+
+def take(a, indices, axis=None, mode="clip", out=None):
+    return _op("take", _as_nd(a), _as_nd(indices), axis=axis, mode=mode,
+               out=out)
+
+
+def take_along_axis(a, indices, axis=0):
+    return _op("take_along_axis", _as_nd(a), _as_nd(indices), axis=axis)
+
+
+def gather_nd(data, indices):
+    return _op("gather_nd", _as_nd(data), _as_nd(indices))
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    return _op("pick", _as_nd(data), _as_nd(index), axis=axis, mode=mode,
+               keepdims=keepdims)
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _op("one_hot", _as_nd(indices), depth=depth, on_value=on_value,
+               off_value=off_value, dtype=str(_canon(dtype)))
+
+
+def meshgrid(*xi, indexing="xy"):
+    out = _op("meshgrid", *[_as_nd(x) for x in xi], indexing=indexing)
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def bincount(x, weights=None, minlength=0):
+    if weights is not None:
+        raise NotImplementedError("bincount weights not supported yet")
+    return _op("bincount", _as_nd(x), minlength=minlength)
+
+
+def diff(a, n=1, axis=-1):
+    return _op("diff", _as_nd(a), n=n, axis=axis)
+
+
+def ediff1d(a):
+    return _op("ediff1d", _as_nd(a))
+
+
+def interp(x, xp, fp):
+    return _op("interp", _as_nd(x), _as_nd(xp), _as_nd(fp))
+
+
+def tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(x) if isinstance(x, (list, tuple)) else x
+                     for x in axes)
+    return _op("tensordot", _as_nd(a), _as_nd(b), axes=axes)
+
+
+def einsum(subscripts, *operands, optimize="optimal"):
+    return _op("einsum", *[_as_nd(o) for o in operands],
+               subscripts=subscripts, optimize=optimize)
+
+
+def cross(a, b, axis=-1):
+    return _op("cross", _as_nd(a), _as_nd(b), axis=axis)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    return _op("topk", _as_nd(data), k=k, axis=axis, ret_typ=ret_typ,
+               is_ascend=is_ascend)
+
+
+# -- dynamic-shape host fallbacks (documented) ------------------------------
+def unique(ar, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    res = _onp.unique(_as_nd(ar).asnumpy(), return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(NDArray(r) for r in res)
+    return NDArray(res)
+
+
+def nonzero(a):
+    res = _onp.nonzero(_as_nd(a).asnumpy())
+    return tuple(NDArray(r) for r in res)
+
+
+def flatnonzero(a, size=None):
+    if size is not None:
+        return _op("flatnonzero_bounded", _as_nd(a), size=size)
+    return NDArray(_onp.flatnonzero(_as_nd(a).asnumpy()))
+
+
+# -- creation ----------------------------------------------------------------
+array = array
+
+
+def _place(data, ctx=None, device=None):
+    arr = NDArray(data)
+    tgt = device or ctx
+    if tgt is not None and tgt != arr.ctx:
+        arr = arr.as_in_ctx(tgt)
+    return arr
+
+
+def zeros(shape, dtype="float32", order="C", ctx=None, device=None):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _place(jnp.zeros(shape, _canon(dtype) or _onp.float32), ctx, device)
+
+
+def ones(shape, dtype="float32", order="C", ctx=None, device=None):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    return _place(jnp.ones(shape, _canon(dtype) or _onp.float32), ctx, device)
+
+
+def full(shape, fill_value, dtype=None, ctx=None, device=None, out=None):
+    import jax.numpy as jnp
+
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if isinstance(fill_value, NDArray):
+        fill_value = fill_value._data
+    data = jnp.full(shape, fill_value,
+                    _canon(dtype) if dtype is not None else None)
+    if out is not None:
+        out._set_data(data)
+        return out
+    return _place(data, ctx, device)
+
+
+def empty(shape, dtype="float32", order="C", ctx=None, device=None):
+    return zeros(shape, dtype, order, ctx, device)
+
+
+def zeros_like(a, dtype=None, ctx=None):
+    import jax.numpy as jnp
+
+    return _place(jnp.zeros(_as_nd(a).shape,
+                            _canon(dtype) or _as_nd(a).dtype), ctx)
+
+
+def ones_like(a, dtype=None, ctx=None):
+    import jax.numpy as jnp
+
+    return _place(jnp.ones(_as_nd(a).shape,
+                           _canon(dtype) or _as_nd(a).dtype), ctx)
+
+
+def full_like(a, fill_value, dtype=None, ctx=None):
+    import jax.numpy as jnp
+
+    return _place(jnp.full(_as_nd(a).shape, fill_value,
+                           _canon(dtype) or _as_nd(a).dtype), ctx)
+
+
+def empty_like(a, dtype=None, ctx=None):
+    return zeros_like(a, dtype, ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    import jax.numpy as jnp
+
+    return _place(jnp.arange(start, stop, step,
+                             _canon(dtype) if dtype else None), ctx, device)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None, device=None):
+    import jax.numpy as jnp
+
+    out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=_canon(dtype) if dtype else None, axis=axis)
+    if retstep:
+        return _place(out[0], ctx, device), float(out[1])
+    return _place(out, ctx, device)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             ctx=None):
+    import jax.numpy as jnp
+
+    return _place(jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                               dtype=_canon(dtype) if dtype else None), ctx)
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None, device=None):
+    import jax.numpy as jnp
+
+    return _place(jnp.eye(N, M, k, _canon(dtype)), ctx, device)
+
+
+def identity(n, dtype="float32", ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def tri(N, M=None, k=0, dtype="float32", ctx=None):
+    import jax.numpy as jnp
+
+    return _place(jnp.tri(N, M, k, _canon(dtype)), ctx)
+
+
+def indices(dimensions, dtype="int32", ctx=None):
+    import jax.numpy as jnp
+
+    return _place(jnp.indices(tuple(dimensions), dtype=_canon(dtype)), ctx)
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, NDArray) and dtype is None:
+        return a
+    return array(a, dtype=dtype)
+
+
+def ascontiguousarray(a, dtype=None):
+    return asarray(a, dtype)
+
+
+def copy(a):
+    return _op("copy", _as_nd(a))
+
+
+def astype(a, dtype):
+    return _as_nd(a).astype(dtype)
+
+
+def may_share_memory(a, b):
+    return a is b
+
+
+def shares_memory(a, b):
+    return a is b
+
+
+def isscalar(x):
+    return _onp.isscalar(x)
+
+
+def ndim(a):
+    return _as_nd(a).ndim if isinstance(a, NDArray) else _onp.ndim(a)
+
+
+def shape(a):
+    return _as_nd(a).shape
+
+
+def size(a, axis=None):
+    if axis is None:
+        return _as_nd(a).size
+    return _as_nd(a).shape[axis]
+
+
+def result_type(*args):
+    import jax.numpy as jnp
+
+    return jnp.result_type(*[
+        a._data if isinstance(a, NDArray) else a for a in args])
+
+
+def isclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    a, b = _both_nd(a, b)
+    diff_ok = less_equal(abs(subtract(a, b)),
+                         add(array(atol, dtype="float32"),
+                             multiply(array(rtol, dtype="float32"), abs(b))))
+    return diff_ok
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return bool(all(isclose(a, b, rtol, atol, equal_nan)).item())
+
+
+def array_equal(a, b):
+    a, b = _both_nd(a, b)
+    if a.shape != b.shape:
+        return False
+    return bool(all(equal(a, b)).item())
+
+
+def fft(*a, **kw):  # namespace placeholder; see np.fft module functions below
+    raise TypeError("use np.fft_ functions")
+
+
+def histogram(a, bins=10, range=None):
+    h, edges = _onp.histogram(_as_nd(a).asnumpy(), bins=bins, range=range)
+    return NDArray(h), NDArray(edges)
+
+
+def index_update(a, key, value):
+    """Functional scatter-update (TPU-native extension; a.at[key].set)."""
+    return _indexing.index_update(_as_nd(a), key,
+                                  value if not isinstance(value, NDArray)
+                                  else value)
+
+
+def index_add(a, key, value):
+    return _indexing.index_add(_as_nd(a), key, value)

@@ -1,0 +1,300 @@
+"""mx.npx — numpy_extension: NN operators and framework controls.
+
+Reference: python/mxnet/numpy_extension (npx namespace: nn ops from
+src/operator/nn/*, sequence ops, control flow, waitall/engine controls).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import apply_op as _op
+from .. import autograd as _ag
+from .. import engine as _engine
+from ..context import cpu, gpu, tpu, num_gpus, num_tpus, current_context  # noqa: F401
+
+_np_active = True
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Reference parity: numpy semantics are always on in this framework."""
+    return True
+
+
+def reset_np():
+    return True
+
+
+def is_np_array():
+    return True
+
+
+def is_np_shape():
+    return True
+
+
+def use_np(func):
+    return func
+
+
+use_np_array = use_np
+
+
+def waitall():
+    _engine.wait_all()
+
+
+def _nd(x):
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+# -- NN ops ------------------------------------------------------------------
+def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                    flatten=True):
+    args = [_nd(data), _nd(weight)]
+    if bias is not None and not no_bias:
+        args.append(_nd(bias))
+        no_bias_eff = False
+    else:
+        no_bias_eff = True
+    return _op("fully_connected", *args, no_bias=no_bias_eff, flatten=flatten,
+               num_hidden=num_hidden)
+
+
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False, layout=None,
+                **kw):
+    args = [_nd(data), _nd(weight)]
+    no_bias_eff = bias is None or no_bias
+    if not no_bias_eff:
+        args.append(_nd(bias))
+    return _op("convolution", *args, kernel=tuple(kernel),
+               stride=tuple(stride), dilate=tuple(dilate), pad=tuple(pad),
+               num_filter=num_filter, num_group=num_group,
+               no_bias=no_bias_eff, layout=layout)
+
+
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), num_filter=0, num_group=1, no_bias=False,
+                  layout=None, **kw):
+    args = [_nd(data), _nd(weight)]
+    no_bias_eff = bias is None or no_bias
+    if not no_bias_eff:
+        args.append(_nd(bias))
+    return _op("deconvolution", *args, kernel=tuple(kernel),
+               stride=tuple(stride), dilate=tuple(dilate), pad=tuple(pad),
+               adj=tuple(adj), num_filter=num_filter, num_group=num_group,
+               no_bias=no_bias_eff, layout=layout)
+
+
+def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
+            global_pool=False, count_include_pad=True, layout=None, **kw):
+    return _op("pooling", _nd(data), kernel=tuple(kernel),
+               pool_type=pool_type, stride=tuple(stride), pad=tuple(pad),
+               global_pool=global_pool, count_include_pad=count_include_pad,
+               layout=layout)
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1):
+    use_batch = _ag.is_training() and not use_global_stats
+    out, new_mean, new_var = _op(
+        "batch_norm", _nd(x), _nd(gamma), _nd(beta), _nd(running_mean),
+        _nd(running_var), eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+        use_batch_stats=use_batch, axis=axis)
+    return out, new_mean, new_var
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5):
+    return _op("layer_norm", _nd(data), _nd(gamma), _nd(beta), axis=axis,
+               eps=eps)
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    return _op("group_norm", _nd(data), _nd(gamma), _nd(beta),
+               num_groups=num_groups, eps=eps)
+
+
+def instance_norm(data, gamma, beta, eps=1e-5):
+    return _op("instance_norm", _nd(data), _nd(gamma), _nd(beta), eps=eps)
+
+
+def rms_norm(data, gamma, axis=-1, eps=1e-6):
+    return _op("rms_norm", _nd(data), _nd(gamma), axis=axis, eps=eps)
+
+
+def activation(data, act_type="relu"):
+    return _op("activation", _nd(data), act_type=act_type)
+
+
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, **kw):
+    if act_type == "prelu":
+        return _op("leaky_relu", _nd(data), _nd(gamma), act_type=act_type)
+    return _op("leaky_relu", _nd(data), act_type=act_type, slope=slope)
+
+
+def relu(data):
+    return _op("relu", _nd(data))
+
+
+def sigmoid(data):
+    return _op("sigmoid", _nd(data))
+
+
+def softmax(data, axis=-1, length=None, temperature=None, use_length=False):
+    if length is not None:
+        return _op("softmax", _nd(data), _nd(length), axis=axis,
+                   temperature=temperature, use_length=True)
+    return _op("softmax", _nd(data), axis=axis, temperature=temperature)
+
+
+def log_softmax(data, axis=-1, temperature=None):
+    return _op("log_softmax", _nd(data), axis=axis, temperature=temperature)
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0):
+    return _op("masked_softmax", _nd(data), _nd(mask), axis=axis,
+               temperature=temperature)
+
+
+def dropout(data, p=0.5, mode="training", **kw):
+    return _op("dropout", _nd(data), p=p, mode=mode,
+               training=_ag.is_training() or mode == "always")
+
+
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    return _op("embedding", _nd(data), _nd(weight), input_dim=input_dim,
+               output_dim=output_dim, sparse_grad=sparse_grad)
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return _op("one_hot", _nd(data), depth=depth, on_value=on_value,
+               off_value=off_value, dtype=dtype)
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False):
+    return _op("pick", _nd(data), _nd(index), axis=axis, mode=mode,
+               keepdims=keepdims)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    return _op("topk", _nd(data), k=k, axis=axis, ret_typ=ret_typ,
+               is_ascend=is_ascend)
+
+
+def smooth_l1(data, scalar=1.0):
+    return _op("smooth_l1", _nd(data), scalar=scalar)
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             blank_label="first"):
+    args = [_nd(data), _nd(label)]
+    if data_lengths is not None:
+        args.append(_nd(data_lengths))
+    if label_lengths is not None:
+        args.append(_nd(label_lengths))
+    return _op("ctc_loss", *args, use_data_lengths=data_lengths is not None,
+               use_label_lengths=label_lengths is not None,
+               blank_label=blank_label)
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if sequence_length is not None:
+        return _op("sequence_mask", _nd(data), _nd(sequence_length),
+                   use_sequence_length=True, value=value, axis=axis)
+    return _op("sequence_mask", _nd(data), use_sequence_length=False,
+               value=value, axis=axis)
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    if sequence_length is not None:
+        return _op("sequence_reverse", _nd(data), _nd(sequence_length),
+                   use_sequence_length=True, axis=axis)
+    return _op("sequence_reverse", _nd(data), use_sequence_length=False,
+               axis=axis)
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0):
+    if sequence_length is not None:
+        return _op("sequence_last", _nd(data), _nd(sequence_length),
+                   use_sequence_length=True, axis=axis)
+    return _op("sequence_last", _nd(data), use_sequence_length=False,
+               axis=axis)
+
+
+def multihead_attention(query, key, value, mask=None, num_heads=1,
+                        dropout=0.0, causal=False, scale=None):
+    args = [_nd(query), _nd(key), _nd(value)]
+    if mask is not None:
+        args.append(_nd(mask))
+    return _op("multihead_attention", *args, num_heads=num_heads,
+               dropout=dropout, causal=causal, scale=scale)
+
+
+def adaptive_avg_pool2d(data, output_size=1):
+    return _op("adaptive_avg_pool2d", _nd(data), output_size=output_size)
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    import jax.numpy as jnp
+
+    d = _nd(data)
+    n = d.size if axis is None else d.shape[axis]
+    return NDArray(jnp.arange(n) * step + start)
+
+
+def gamma(data):
+    return _op("gamma", _nd(data))
+
+
+def gammaln(data):
+    return _op("gammaln", _nd(data))
+
+
+def erf(data):
+    return _op("erf", _nd(data))
+
+
+def erfinv(data):
+    return _op("erfinv", _nd(data))
+
+
+def stop_gradient(data):
+    return _op("stop_gradient", _nd(data))
+
+
+def cast(data, dtype):
+    return _nd(data).astype(dtype)
+
+
+def reshape_like(lhs, rhs):
+    return _nd(lhs).reshape(_nd(rhs).shape)
+
+
+def broadcast_like(lhs, rhs):
+    return _nd(lhs).broadcast_to(_nd(rhs).shape)
+
+
+def slice_axis(data, axis=0, begin=0, end=None):
+    key = [slice(None)] * _nd(data).ndim
+    key[axis] = slice(begin, end)
+    return _nd(data)[tuple(key)]
+
+
+def slice_like(data, shape_like, axes=None):
+    d, s = _nd(data), _nd(shape_like)
+    key = []
+    for i in range(d.ndim):
+        if axes is None or i in axes:
+            key.append(slice(0, s.shape[i]))
+        else:
+            key.append(slice(None))
+    return d[tuple(key)]
+
+
+# control flow lowered to lax.scan/while/cond lives in .control_flow
+from .control_flow import foreach, while_loop, cond  # noqa: E402,F401

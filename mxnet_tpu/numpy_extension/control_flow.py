@@ -1,0 +1,171 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference: src/operator/control_flow.cc (_foreach:1096, _while_loop:1157,
+_cond:1218) — higher-order ops carrying nnvm subgraphs. TPU-native design:
+the python body is evaluated once on tracer-backed NDArrays to produce a pure
+XLA subcomputation, then lowered to lax.scan / lax.while_loop / lax.cond —
+compiler-friendly control flow with static shapes (no python loop inside jit).
+
+Gradient semantics: gradients flow through the explicit operands (``data`` and
+states / loop_vars). Arrays captured by closure inside the body participate in
+the computation but do not receive gradients through the control-flow op —
+pass them through states, or use gluon.rnn layers (which thread weights as
+explicit scan operands). The reference had the same structure: subgraph inputs
+must be declared (control_flow.cc subgraph attrs).
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import Op, invoke
+from .. import autograd as _ag
+from .. import _deferred_compute as _dc
+
+
+def _wrap(x):
+    return NDArray(x)
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def _as_list(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def foreach(body, data, init_states):
+    """Scan ``body`` over axis 0 of ``data`` (reference: npx.foreach).
+
+    body(x_t, states) -> (out_t, new_states). Lowered to lax.scan.
+    """
+    data_list = _as_list(data)
+    states0 = _as_list(init_states)
+    n_data = len(data_list)
+
+    def fn(*args):
+        datas, states = args[:n_data], args[n_data:]
+
+        def scan_fn(carry, xs):
+            with _ag.pause(), _dc.suspend():
+                x_in = [_wrap(x) for x in xs] if n_data > 1 else _wrap(xs[0])
+                out, new_states = body(x_in, [_wrap(c) for c in carry])
+            outs = tuple(_unwrap(o) for o in _as_list(out))
+            return tuple(_unwrap(s) for s in _as_list(new_states)), outs
+
+        carry, ys = lax.scan(scan_fn, tuple(states), tuple(datas))
+        return ys + carry
+
+    op = Op("foreach", lambda **a: fn, nout=0)
+    res = invoke(op, data_list + states0, {})
+    res = res if isinstance(res, tuple) else (res,)
+    # split back into (outputs, states); count outputs by running shapes
+    n_states = len(states0)
+    outs = res[: len(res) - n_states]
+    states = list(res[len(res) - n_states:])
+    out = outs[0] if len(outs) == 1 else list(outs)
+    return out, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Bounded while loop (reference: npx.while_loop).
+
+    cond(*loop_vars) -> boolean scalar; func(*loop_vars) -> (step_output,
+    new_loop_vars). Returns (outputs stacked to max_iterations, final vars).
+    XLA requires static shapes, so max_iterations is mandatory when step
+    outputs are produced; rows beyond the actual iteration count are zeros.
+    """
+    lvars = _as_list(loop_vars)
+
+    # probe: does func produce step outputs?
+    with _ag.pause(), _dc.suspend():
+        probe_out, _ = func(*lvars)
+    has_out = probe_out is not None and len(_as_list(probe_out)) > 0
+    if has_out and max_iterations is None:
+        raise MXNetError("while_loop with step outputs requires "
+                         "max_iterations on TPU (static shapes)")
+
+    def fn(*args):
+        import jax.numpy as jnp
+
+        def cond_w(vals):
+            with _ag.pause(), _dc.suspend():
+                c = cond(*[_wrap(v) for v in vals[0]])
+            return _unwrap(c).astype(bool).reshape(()) & (vals[1] <
+                                                          (max_iterations or
+                                                           2 ** 31 - 1))
+
+        def body_w(vals):
+            with _ag.pause(), _dc.suspend():
+                _, new_vars = func(*[_wrap(v) for v in vals[0]])
+            return (tuple(_unwrap(v) for v in _as_list(new_vars)),
+                    vals[1] + 1)
+
+        if not has_out:
+            final, n = lax.while_loop(cond_w, body_w, (tuple(args),
+                                                       jnp.int32(0)))
+            return final + (n,)
+
+        def scan_fn(carry, _):
+            vals, n, active = carry
+            with _ag.pause(), _dc.suspend():
+                c = cond(*[_wrap(v) for v in vals])
+                out, new_vars = func(*[_wrap(v) for v in vals])
+            act = active & _unwrap(c).astype(bool).reshape(())
+            outs = tuple(jnp.where(act, _unwrap(o), jnp.zeros_like(_unwrap(o)))
+                         for o in _as_list(out))
+            new = tuple(jnp.where(act, _unwrap(v), old)
+                        for v, old in zip(_as_list(new_vars), vals))
+            return (new, n + act.astype(jnp.int32), act), outs
+
+        (final, n, _), ys = lax.scan(
+            scan_fn, (tuple(args), jnp.int32(0), jnp.bool_(True)),
+            None, length=max_iterations)
+        return ys + final + (n,)
+
+    op = Op("while_loop", lambda **a: fn, nout=0)
+    res = invoke(op, lvars, {})
+    res = res if isinstance(res, tuple) else (res,)
+    res, _n_steps = res[:-1], res[-1]
+    n_vars = len(lvars)
+    if not has_out:
+        return [], list(res)
+    outs = res[: len(res) - n_vars]
+    finals = list(res[len(res) - n_vars:])
+    return (outs[0] if len(outs) == 1 else list(outs)), finals
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Conditional execution (reference: npx.cond). Lowered to lax.cond.
+
+    ``inputs``: operand arrays passed to both branches; if omitted the
+    branches are thunks closing over their operands (no grads to captures).
+    """
+    ins = _as_list(inputs) if inputs is not None else []
+
+    def fn(p, *args):
+        def then_w(ops_):
+            with _ag.pause(), _dc.suspend():
+                out = then_func(*[_wrap(o) for o in ops_]) if ins else \
+                    then_func()
+            return tuple(_unwrap(o) for o in _as_list(out))
+
+        def else_w(ops_):
+            with _ag.pause(), _dc.suspend():
+                out = else_func(*[_wrap(o) for o in ops_]) if ins else \
+                    else_func()
+            return tuple(_unwrap(o) for o in _as_list(out))
+
+        return lax.cond(p.astype(bool).reshape(()), then_w, else_w, args)
+
+    op = Op("cond", lambda **a: fn, nout=0)
+    p = pred if isinstance(pred, NDArray) else NDArray(jax.numpy.asarray(pred))
+    res = invoke(op, [p] + ins, {})
+    return res if not isinstance(res, tuple) or len(res) > 1 else res[0]

@@ -1,0 +1,8 @@
+"""Operator library: importing this package registers all built-in ops."""
+from .registry import Op, register, get_op, list_ops, invoke, apply_op
+from . import _core  # noqa: F401 — registers elemwise/reduce/shape/linalg ops
+from . import nn  # noqa: F401 — registers NN ops
+from . import indexing  # noqa: F401 — registers slice/scatter ops
+from . import rnn  # noqa: F401 — registers the fused scan RNN op
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "apply_op"]

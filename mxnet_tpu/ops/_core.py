@@ -1,0 +1,316 @@
+"""Core operator definitions (elementwise, reductions, shape, linalg).
+
+TPU-native equivalent of the reference op library's tensor/ + numpy/ subtrees
+(src/operator/tensor/*, src/operator/numpy/* — 562 NNVM ops). Each op lowers to
+jax.numpy / lax, i.e. straight to XLA HLO; XLA's fusion replaces the reference's
+mshadow kernels, pointwise-fusion pass and cuDNN/oneDNN fast paths. Ops are
+registered through ops.registry so every invocation is recordable (autograd)
+and traceable (deferred compute -> CachedOp jit).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+# ---------------------------------------------------------------------------
+# elementwise unary — reference: src/operator/tensor/elemwise_unary_op_basic.cc
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "negative": jnp.negative,
+    "sign": jnp.sign,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "cbrt": jnp.cbrt,
+    "square": jnp.square,
+    "reciprocal": jnp.reciprocal,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "trunc": jnp.trunc,
+    "rint": jnp.rint,
+    "fix": jnp.fix,
+    "invert": jnp.invert,
+    "logical_not": jnp.logical_not,
+    "isnan": jnp.isnan,
+    "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite,
+    "isposinf": jnp.isposinf,
+    "isneginf": jnp.isneginf,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "sigmoid": jax.nn.sigmoid,
+    "relu": jax.nn.relu,
+    "softsign": jax.nn.soft_sign,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "conj": jnp.conj,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "angle": jnp.angle,
+    "copy": lambda x: x,  # buffers are immutable; identity is a true copy
+    "stop_gradient": jax.lax.stop_gradient,
+}
+for _name, _fn in _UNARY.items():
+    register(_name, (lambda f: (lambda **a: f))(_fn))
+
+# ---------------------------------------------------------------------------
+# elementwise binary — reference: elemwise_binary_broadcast_op*.cc
+# ---------------------------------------------------------------------------
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "true_divide": jnp.true_divide,
+    "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod,
+    "fmod": jnp.fmod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp,
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "less": jnp.less,
+    "less_equal": jnp.less_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+    "left_shift": jnp.left_shift,
+    "right_shift": jnp.right_shift,
+    "matmul": jnp.matmul,
+    "dot": jnp.dot,
+    "copysign": jnp.copysign,
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+    "ldexp": jnp.ldexp,
+    "nextafter": jnp.nextafter,
+}
+for _name, _fn in _BINARY.items():
+    register(_name, (lambda f: (lambda **a: f))(_fn))
+
+register("inner", lambda **a: jnp.inner)
+register("outer", lambda **a: jnp.outer)
+register("vdot", lambda **a: jnp.vdot)
+register("kron", lambda **a: jnp.kron)
+register("cross", lambda axis=-1, **a: (lambda x, y: jnp.cross(x, y, axis=axis)))
+register("tensordot",
+         lambda axes=2: (lambda a, b: jnp.tensordot(a, b, axes=axes)))
+
+# ---------------------------------------------------------------------------
+# reductions — reference: src/operator/tensor/broadcast_reduce_op_value.cc
+# ---------------------------------------------------------------------------
+def _red(fn, **extra):
+    def make(axis=None, keepdims=False, dtype=None, ddof=None, **kw):
+        def f(x):
+            kwargs = dict(axis=axis, keepdims=keepdims)
+            if dtype is not None:
+                kwargs["dtype"] = dtype
+            if ddof is not None:
+                kwargs["ddof"] = ddof
+            return fn(x, **kwargs)
+
+        return f
+
+    return make
+
+
+register("sum", _red(jnp.sum))
+register("mean", _red(jnp.mean))
+register("prod", _red(jnp.prod))
+register("std", _red(jnp.std))
+register("var", _red(jnp.var))
+register("max", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.max(x, axis=axis, keepdims=keepdims)))
+register("min", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.min(x, axis=axis, keepdims=keepdims)))
+register("argmax", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.argmax(x, axis=axis, keepdims=keepdims)))
+register("argmin", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.argmin(x, axis=axis, keepdims=keepdims)))
+register("all", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.all(x, axis=axis, keepdims=keepdims)))
+register("any", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.any(x, axis=axis, keepdims=keepdims)))
+register("cumsum", lambda axis=None, dtype=None:
+         (lambda x: jnp.cumsum(x, axis=axis, dtype=dtype)))
+register("cumprod", lambda axis=None, dtype=None:
+         (lambda x: jnp.cumprod(x, axis=axis, dtype=dtype)))
+register("logsumexp", lambda axis=None, keepdims=False:
+         (lambda x: jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)))
+register("nansum", _red(jnp.nansum))
+register("nanmean", _red(jnp.nanmean))
+register("nanmax", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.nanmax(x, axis=axis, keepdims=keepdims)))
+register("nanmin", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.nanmin(x, axis=axis, keepdims=keepdims)))
+register("median", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.median(x, axis=axis, keepdims=keepdims)))
+register("average", lambda axis=None: (lambda x, w: jnp.average(x, axis, w)))
+register("norm", lambda ord=None, axis=None, keepdims=False:
+         (lambda x: jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims)))
+register("trace", lambda offset=0, axis1=0, axis2=1:
+         (lambda x: jnp.trace(x, offset, axis1, axis2)))
+
+# ---------------------------------------------------------------------------
+# shape manipulation — reference: matrix_op.cc
+# ---------------------------------------------------------------------------
+register("reshape", lambda newshape=None, **a: (lambda x: jnp.reshape(x, newshape)))
+register("transpose", lambda axes=None: (lambda x: jnp.transpose(x, axes)))
+register("swapaxes", lambda axis1=0, axis2=1:
+         (lambda x: jnp.swapaxes(x, axis1, axis2)))
+register("moveaxis", lambda source=0, destination=0:
+         (lambda x: jnp.moveaxis(x, source, destination)))
+register("squeeze", lambda axis=None: (lambda x: jnp.squeeze(x, axis)))
+register("expand_dims", lambda axis=0: (lambda x: jnp.expand_dims(x, axis)))
+register("broadcast_to", lambda shape=None: (lambda x: jnp.broadcast_to(x, shape)))
+register("tile", lambda reps=1: (lambda x: jnp.tile(x, reps)))
+register("repeat", lambda repeats=1, axis=None:
+         (lambda x: jnp.repeat(x, repeats, axis)))
+register("flip", lambda axis=None: (lambda x: jnp.flip(x, axis)))
+register("roll", lambda shift=0, axis=None: (lambda x: jnp.roll(x, shift, axis)))
+register("rot90", lambda k=1, axes=(0, 1): (lambda x: jnp.rot90(x, k, axes)))
+register("astype", lambda dtype="float32": (lambda x: x.astype(dtype)))
+register("clip", lambda a_min=None, a_max=None:
+         (lambda x: jnp.clip(x, a_min, a_max)))
+register("round", lambda decimals=0: (lambda x: jnp.round(x, decimals)))
+register("diag", lambda k=0: (lambda x: jnp.diag(x, k)))
+register("diagonal", lambda offset=0, axis1=0, axis2=1:
+         (lambda x: jnp.diagonal(x, offset, axis1, axis2)))
+register("tril", lambda k=0: (lambda x: jnp.tril(x, k)))
+register("triu", lambda k=0: (lambda x: jnp.triu(x, k)))
+register("pad", lambda pad_width=0, mode="constant", constant_values=0:
+         (lambda x: jnp.pad(x, pad_width, mode=mode,
+                            **({"constant_values": constant_values}
+                               if mode == "constant" else {}))))
+register("concatenate", lambda axis=0: (lambda *xs: jnp.concatenate(xs, axis)))
+register("stack", lambda axis=0: (lambda *xs: jnp.stack(xs, axis)))
+register("split", lambda indices_or_sections=1, axis=0:
+         (lambda x: tuple(jnp.split(x, indices_or_sections, axis))))
+register("array_split", lambda indices_or_sections=1, axis=0:
+         (lambda x: tuple(jnp.array_split(x, indices_or_sections, axis))))
+register("atleast_1d", lambda **a: jnp.atleast_1d)
+register("atleast_2d", lambda **a: jnp.atleast_2d)
+register("atleast_3d", lambda **a: jnp.atleast_3d)
+register("where", lambda **a: (lambda c, x, y: jnp.where(c, x, y)))
+register("searchsorted", lambda side="left":
+         (lambda a, v: jnp.searchsorted(a, v, side=side)))
+register("sort", lambda axis=-1: (lambda x: jnp.sort(x, axis=axis)))
+register("argsort", lambda axis=-1: (lambda x: jnp.argsort(x, axis=axis)))
+register("topk", lambda k=1, axis=-1, ret_typ="indices", is_ascend=False:
+         (lambda x: _topk(x, k, axis, ret_typ, is_ascend)))
+register("take", lambda axis=None, mode="clip":
+         (lambda x, idx: jnp.take(x, idx, axis=axis,
+                                  mode="clip" if mode == "raise" else mode)))
+register("take_along_axis", lambda axis=0:
+         (lambda x, idx: jnp.take_along_axis(x, idx, axis=axis)))
+register("gather_nd", lambda **a: _gather_nd)
+register("one_hot", lambda depth=1, on_value=1.0, off_value=0.0, dtype="float32":
+         (lambda idx: jax.nn.one_hot(idx, depth, dtype=dtype) * (on_value - off_value)
+          + off_value))
+register("interp", lambda **a: (lambda x, xp, fp: jnp.interp(x, xp, fp)))
+register("unravel_index", lambda shape=None:
+         (lambda idx: jnp.stack(jnp.unravel_index(idx, shape))))
+register("ravel_multi_index", lambda shape=None:
+         (lambda multi: jnp.ravel_multi_index(tuple(multi), shape, mode="clip")))
+register("meshgrid", lambda indexing="xy":
+         (lambda *xs: tuple(jnp.meshgrid(*xs, indexing=indexing))))
+register("bincount", lambda minlength=0, length=None:
+         (lambda x: jnp.bincount(x, minlength=minlength, length=length)))
+register("diff", lambda n=1, axis=-1: (lambda x: jnp.diff(x, n=n, axis=axis)))
+register("ediff1d", lambda **a: jnp.ediff1d)
+register("flatnonzero_bounded", lambda size=None:
+         (lambda x: jnp.flatnonzero(x, size=size, fill_value=-1)))
+register("tril_indices_from", lambda k=0:
+         (lambda x: jnp.stack(jnp.tril_indices_from(x, k))))
+
+
+def _topk(x, k, axis, ret_typ, is_ascend):
+    y = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(-y if is_ascend else y, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    return (vals, idx)
+
+
+def _gather_nd(data, indices):
+    idx = tuple(indices[i] for i in range(indices.shape[0]))
+    return data[idx]
+
+
+# ---------------------------------------------------------------------------
+# linalg — reference: src/operator/numpy/linalg/*
+# ---------------------------------------------------------------------------
+_LINALG = {
+    "linalg_inv": jnp.linalg.inv,
+    "linalg_pinv": jnp.linalg.pinv,
+    "linalg_det": jnp.linalg.det,
+    "linalg_cholesky": jnp.linalg.cholesky,
+    "linalg_eigh": lambda x: tuple(jnp.linalg.eigh(x)),
+    "linalg_eigvalsh": jnp.linalg.eigvalsh,
+    "linalg_matrix_rank": jnp.linalg.matrix_rank,
+}
+for _name, _fn in _LINALG.items():
+    register(_name, (lambda f: (lambda **a: f))(_fn))
+
+register("linalg_svd", lambda full_matrices=True, compute_uv=True:
+         (lambda x: tuple(jnp.linalg.svd(x, full_matrices=full_matrices))
+          if compute_uv else jnp.linalg.svd(x, compute_uv=False)))
+register("linalg_qr", lambda mode="reduced":
+         (lambda x: tuple(jnp.linalg.qr(x, mode=mode))))
+register("linalg_slogdet", lambda **a: (lambda x: tuple(jnp.linalg.slogdet(x))))
+register("linalg_solve", lambda **a: (lambda a_, b: jnp.linalg.solve(a_, b)))
+register("linalg_lstsq", lambda rcond=None:
+         (lambda a_, b: tuple(jnp.linalg.lstsq(a_, b, rcond=rcond))))
+register("linalg_matrix_power", lambda n=1:
+         (lambda x: jnp.linalg.matrix_power(x, n)))
+register("linalg_multi_dot", lambda **a:
+         (lambda *xs: jnp.linalg.multi_dot(list(xs))))
+register("linalg_tensorsolve", lambda axes=None:
+         (lambda a_, b: jnp.linalg.tensorsolve(a_, b, axes=axes)))
+register("linalg_tensorinv", lambda ind=2:
+         (lambda x: jnp.linalg.tensorinv(x, ind=ind)))
+register("einsum", lambda subscripts="", optimize="optimal":
+         (lambda *xs: jnp.einsum(subscripts, *xs,
+                                 optimize=optimize or "optimal")))
+
+# fft — reference: src/operator/contrib/fft
+register("fft", lambda n=None, axis=-1: (lambda x: jnp.fft.fft(x, n, axis)))
+register("ifft", lambda n=None, axis=-1: (lambda x: jnp.fft.ifft(x, n, axis)))
+register("rfft", lambda n=None, axis=-1: (lambda x: jnp.fft.rfft(x, n, axis)))
+register("irfft", lambda n=None, axis=-1: (lambda x: jnp.fft.irfft(x, n, axis)))

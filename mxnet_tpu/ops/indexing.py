@@ -1,0 +1,151 @@
+"""Indexing: __getitem__ / __setitem__ lowering.
+
+Reference: src/operator/tensor/indexing_op.* and the python indexing logic in
+python/mxnet/numpy/multiarray.py. Static keys (ints/slices/ellipsis/None)
+become a cached XLA slice program; integer-array advanced indexing becomes a
+gather with the index arrays as real op inputs (so it works under autograd and
+deferred-compute tracing). Boolean-mask indexing produces a data-dependent
+shape, which XLA cannot compile — it is executed eagerly on host (documented
+dynamic-shape fallback, mirroring the reference's SetShapeFromChunk escape
+hatch, src/imperative/imperative.cc:123).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from .registry import register, apply_op, get_op, invoke
+
+_SLICE = "s"
+_INT = "i"
+_ELL = "e"
+_NONE = "n"
+_ARR = "a"
+
+
+def _freeze_key(key):
+    """Encode an index key into a hashable spec; returns (spec, array_items)."""
+    if not isinstance(key, tuple):
+        key = (key,)
+    spec, arrays = [], []
+    from ..ndarray.ndarray import NDArray
+
+    for item in key:
+        if isinstance(item, slice):
+            spec.append((_SLICE, item.start, item.stop, item.step))
+        elif isinstance(item, (int, onp.integer)):
+            spec.append((_INT, int(item)))
+        elif item is Ellipsis:
+            spec.append((_ELL,))
+        elif item is None:
+            spec.append((_NONE,))
+        elif isinstance(item, NDArray):
+            spec.append((_ARR,))
+            arrays.append(item)
+        elif isinstance(item, (list, onp.ndarray)):
+            arr = NDArray(onp.asarray(item))
+            spec.append((_ARR,))
+            arrays.append(arr)
+        else:
+            raise MXNetError(f"unsupported index item {item!r}")
+    return tuple(spec), arrays
+
+
+def _thaw_key(spec, arrays):
+    out, it = [], iter(arrays)
+    for s in spec:
+        if s[0] == _SLICE:
+            out.append(slice(s[1], s[2], s[3]))
+        elif s[0] == _INT:
+            out.append(s[1])
+        elif s[0] == _ELL:
+            out.append(Ellipsis)
+        elif s[0] == _NONE:
+            out.append(None)
+        else:
+            out.append(next(it))
+    return tuple(out)
+
+
+@register("slice_key")
+def _slice_key(spec=()):
+    def f(x, *idx_arrays):
+        return x[_thaw_key(spec, idx_arrays)]
+
+    return f
+
+
+def _is_bool_arr(a):
+    return str(a.dtype) == "bool"
+
+
+def getitem(self, key):
+    from ..ndarray.ndarray import NDArray
+
+    spec, arrays = _freeze_key(key)
+    if any(_is_bool_arr(a) for a in arrays):
+        # dynamic output shape — host fallback, not differentiable/traceable
+        from .. import _deferred_compute as dc
+        from .. import autograd as ag
+
+        if dc.is_tracing():
+            raise MXNetError(
+                "boolean-mask indexing has a data-dependent shape and cannot "
+                "be traced into a compiled graph; use np.where or masked ops"
+            )
+        np_key = _thaw_key(spec, [a.asnumpy() for a in arrays])
+        return NDArray(self.asnumpy()[np_key])
+    return invoke(get_op("slice_key"), [self] + arrays, {"spec": spec})
+
+
+def setitem(self, key, value):
+    from ..ndarray.ndarray import NDArray
+    from .. import autograd as ag
+    from .. import _deferred_compute as dc
+    import jax.numpy as jnp
+
+    if dc.is_tracing():
+        raise MXNetError("in-place indexed assignment is not supported inside "
+                         "a hybridized forward; return new arrays instead")
+    if ag.is_recording() and self._ag_info is not None:
+        raise MXNetError("in-place indexed assignment on an array recorded by "
+                         "autograd is not allowed")
+    spec, arrays = _freeze_key(key)
+    if isinstance(value, NDArray):
+        value = value._data
+    if any(_is_bool_arr(a) for a in arrays):
+        np_key = _thaw_key(spec, [a.asnumpy() for a in arrays])
+        host = self.asnumpy()
+        host[np_key] = onp.asarray(value)
+        self._set_data(jnp.asarray(host))
+        return
+    jkey = _thaw_key(spec, [a._data for a in arrays])
+    self._set_data(self._data.at[jkey].set(value))
+
+
+# scatter/index update ops usable under autograd & tracing ------------------
+@register("index_update")
+def _index_update(spec=()):
+    def f(x, v, *idx_arrays):
+        return x.at[_thaw_key(spec, idx_arrays)].set(v)
+
+    return f
+
+
+@register("index_add")
+def _index_add(spec=()):
+    def f(x, v, *idx_arrays):
+        return x.at[_thaw_key(spec, idx_arrays)].add(v)
+
+    return f
+
+
+def index_update(data, key, value):
+    """Functional indexed update: returns a new array (TPU-native scatter)."""
+    spec, arrays = _freeze_key(key)
+    return invoke(get_op("index_update"), [data, value] + arrays, {"spec": spec})
+
+
+def index_add(data, key, value):
+    spec, arrays = _freeze_key(key)
+    return invoke(get_op("index_add"), [data, value] + arrays, {"spec": spec})

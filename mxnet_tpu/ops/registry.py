@@ -1,0 +1,213 @@
+"""Operator registry and the single imperative dispatch chokepoint.
+
+TPU-native replacement for the reference's nnvm op registry + imperative runtime
+(src/imperative/imperative.cc:49-98 Imperative::Invoke/InvokeOp, registration
+attrs in include/mxnet/op_attr_types.h). Design:
+
+- An :class:`Op` is a name plus ``make_fn(**attrs)`` returning a *pure* function
+  over ``jax.Array`` operands. Purity + static attrs is what lets the same op
+  serve three execution modes from one definition:
+
+  1. **eager**    — call the fn; XLA dispatches asynchronously (the reference's
+     ThreadedEngine role is played by PJRT async execution);
+  2. **recorded** — under ``autograd.record()`` the fn goes through ``jax.vjp``
+     and a tape node is appended (reference: Imperative::RecordOp,
+     imperative.cc:204);
+  3. **traced**   — under deferred compute the invocation is also recorded into
+     a Symbol graph which CachedOp later compiles into ONE ``jax.jit`` program
+     (reference: DCInfo deferred compute, imperative.h:94; CachedOp,
+     src/imperative/cached_op.cc — whole-graph jit replaces per-node RunGraph).
+
+- ``invoke(op, inputs, attrs)`` is the only path from the user API to compute —
+  every namespace function (mx.np / mx.npx / mx.nd / gluon layers) funnels here,
+  mirroring how all reference frontends funnel into Imperative::Invoke.
+
+Shape/dtype inference (reference FInferShape/FInferType) comes for free from
+jax.eval_shape over the same fn, used by Symbol.infer_shape.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+import jax
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["Op", "register", "get_op", "list_ops", "invoke", "apply_op"]
+
+_OPS: dict[str, "Op"] = {}
+
+
+def _freeze(value):
+    """Make attrs hashable (lists->tuples, dicts->sorted item tuples)."""
+    if isinstance(value, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    if isinstance(value, onp.ndarray):
+        return (value.shape, str(value.dtype), value.tobytes())
+    return value
+
+
+class Op:
+    """A registered operator: pure-fn factory + metadata."""
+
+    __slots__ = ("name", "_make_fn", "_fn_cache", "needs_rng", "nout")
+
+    def __init__(self, name, make_fn, needs_rng: bool = False, nout=1):
+        self.name = name
+        self._make_fn = make_fn
+        self._fn_cache: dict = {}
+        self.needs_rng = needs_rng
+        self.nout = nout
+
+    def fn(self, **attrs):
+        """Pure function for this op specialized on static attrs (cached)."""
+        key = _freeze(attrs)
+        f = self._fn_cache.get(key)
+        if f is None:
+            f = self._make_fn(**attrs)
+            self._fn_cache[key] = f
+        return f
+
+    def __repr__(self):
+        return f"Op({self.name})"
+
+
+def register(name, make_fn=None, *, needs_rng=False, nout=1):
+    """Register an operator. Usable directly or as a decorator on make_fn."""
+
+    def _do(mf):
+        if name in _OPS:
+            raise MXNetError(f"op '{name}' already registered")
+        op = Op(name, mf, needs_rng=needs_rng, nout=nout)
+        _OPS[name] = op
+        return op
+
+    if make_fn is None:
+        return _do
+    return _do(make_fn)
+
+
+def get_op(name: str) -> Op:
+    try:
+        return _OPS[name]
+    except KeyError:
+        raise MXNetError(f"op '{name}' is not registered") from None
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+# ---------------------------------------------------------------------------
+# invoke — the imperative chokepoint
+# ---------------------------------------------------------------------------
+_EAGER_JIT = os.environ.get("MXNET_EAGER_JIT", "0") == "1"
+
+
+class _TLS(threading.local):
+    pass
+
+
+_tls = _TLS()
+
+
+def invoke(op: Op, inputs, attrs=None, out=None):
+    """Execute ``op`` on NDArray ``inputs``; returns NDArray or tuple thereof.
+
+    Mirrors Imperative::Invoke (imperative.cc:98): resolve kernel, execute
+    (async via XLA), record autograd tape / deferred-compute graph as needed.
+    """
+    from ..ndarray.ndarray import NDArray
+    from .. import autograd as ag
+    from .. import _deferred_compute as dc
+
+    attrs = attrs or {}
+    fn = op.fn(**attrs)
+
+    arg_list = list(inputs)
+    if op.needs_rng:
+        from .. import random as _rnd
+
+        # the PRNG key is an explicit leading operand (pure fn; under CachedOp
+        # tracing it becomes a fresh-per-call input, see _deferred_compute)
+        arg_list = [_rnd._next_key()] + arg_list
+    datas = [x._data if isinstance(x, NDArray) else x for x in arg_list]
+
+    from .. import amp as _amp
+
+    if _amp.is_enabled():
+        datas = _amp.maybe_cast_inputs(op.name, datas)
+
+    node = None
+    if ag.is_recording() and any(
+        isinstance(x, NDArray) and x._ag_info is not None for x in inputs
+    ):
+        try:
+            out_data, node = ag._record_op(fn, arg_list, datas)
+        except TypeError:
+            # op not differentiable through vjp (e.g. int-only); fall through
+            out_data = fn(*datas)
+    else:
+        try:
+            out_data = fn(*datas)
+        except MXNetError:
+            raise
+        except (TypeError, ValueError, ZeroDivisionError, IndexError):
+            raise
+        except Exception as e:  # noqa: BLE001 — normalize XLA errors
+            raise MXNetError(f"op '{op.name}' failed: {e}") from e
+
+    multi = isinstance(out_data, (tuple, list))
+    outs_data = tuple(out_data) if multi else (out_data,)
+    outputs = tuple(NDArray(d) for d in outs_data)
+
+    if node is not None:
+        for i, o in enumerate(outputs):
+            if _is_float(o.dtype):
+                o._ag_info = ag.AGInfo(node=node, index=i)
+
+    if dc.is_tracing():
+        dc._record_op(op, attrs, list(inputs), outputs)
+
+    from .. import engine
+
+    if engine.is_naive():
+        for o in outputs:
+            o.wait_to_read()
+
+    if out is not None:
+        _write_out(out, outputs, multi)
+        return out
+    return outputs if multi else outputs[0]
+
+
+def _write_out(out, outputs, multi):
+    from ..ndarray.ndarray import NDArray
+
+    if multi:
+        for o_dst, o_src in zip(out, outputs):
+            o_dst._set_data(o_src._data)
+    else:
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        assert isinstance(out, NDArray)
+        out._set_data(outputs[0]._data)
+        out._ag_info = outputs[0]._ag_info
+
+
+def _is_float(dtype) -> bool:
+    try:
+        return onp.issubdtype(onp.dtype(dtype), onp.floating)
+    except TypeError:
+        return str(dtype) in ("bfloat16", "float8_e4m3fn", "float8_e5m2")
+
+
+def apply_op(name: str, *inputs, **attrs):
+    """Convenience: invoke a registered op by name."""
+    out = attrs.pop("out", None)
+    return invoke(get_op(name), inputs, attrs, out=out)

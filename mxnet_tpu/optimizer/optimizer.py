@@ -1,0 +1,659 @@
+"""Optimizers with fused XLA update kernels.
+
+Reference: python/mxnet/optimizer/*.py (20 optimizers) deferring math to fused
+C++/CUDA update ops (src/operator/optimizer_op.cc:49-1044 — sgd_update,
+sgd_mom_update, adam_update, lamb_*, ftml, signum, ...). TPU-native design:
+each optimizer's step is ONE jitted XLA program with donated weight/state
+buffers, so the update is fused and executes in-place in HBM — the same
+property the reference's fused kernels provided, obtained from the compiler.
+Hyper-parameters (lr, wd, t) are passed as device scalars so changing them
+never retraces.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, Registry
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "AdaGrad",
+           "AdaDelta", "Adamax", "Nadam", "Ftrl", "FTML", "Signum", "LAMB",
+           "LARS", "AdaBelief", "SGLD", "DCASGD", "create", "register"]
+
+_registry = Registry("optimizer")
+register = _registry.register
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _registry.get(name)(**kwargs)
+
+
+def _f32(x):
+    return jnp.float32(x)
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer/optimizer.py Optimizer).
+
+    State layout is a dict name->NDArray per parameter; ``update`` rebinds the
+    weight (and state) buffers with the jitted step's donated outputs.
+    """
+
+    def __init__(self, learning_rate=0.01, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=None, lr_scheduler=None, multi_precision=False,
+                 param_dict=None, **kwargs):
+        self.lr = learning_rate
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.multi_precision = multi_precision
+        self.num_update = 0
+        self._index_update_count = {}
+        self.param_dict = param_dict or {}
+        self.lr_mult, self.wd_mult = {}, {}
+
+    # -- hyperparameter plumbing -------------------------------------------
+    def set_learning_rate(self, lr):
+        self.lr = lr
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.base_lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    @learning_rate.setter
+    def learning_rate(self, lr):
+        self.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
+            else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        return lr * self.lr_mult.get(index, 1.0)
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        return wd * self.wd_mult.get(index, 1.0)
+
+    def _update_count(self, index):
+        self._index_update_count[index] = \
+            self._index_update_count.get(index, 0) + 1
+        self.num_update = max(self.num_update,
+                              self._index_update_count[index])
+        return self._index_update_count[index]
+
+    # -- state --------------------------------------------------------------
+    def create_state(self, index, weight) -> dict:
+        return {}
+
+    def create_state_multi_precision(self, index, weight):
+        state = self.create_state(index, weight)
+        if self.multi_precision and str(weight.dtype) in ("float16",
+                                                          "bfloat16"):
+            state["weight_fp32"] = NDArray(
+                weight._data.astype(jnp.float32))
+        return state
+
+    # -- the update ---------------------------------------------------------
+    def update(self, index, weight, grad, state):
+        """Single-param in-place update. Lists are accepted for parity."""
+        if isinstance(index, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self._update_one(i, w, g, s)
+        else:
+            self._update_one(index, weight, grad, state)
+
+    update_multi_precision = update
+
+    def _update_one(self, index, weight, grad, state):
+        t = self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        if self.rescale_grad != 1.0:
+            # rescale OUTSIDE the jitted step: Trainer mutates rescale_grad
+            # per call (trainer.py step), so it must not be baked into the
+            # compiled step as a trace-time constant
+            grad = NDArray(_rescale_jit(grad._data,
+                                        _f32(self.rescale_grad)))
+        if "weight_fp32" in state:
+            # multi-precision: update the fp32 master, round down to the
+            # low-precision weight (reference: mp_sgd_update etc.)
+            master = state["weight_fp32"]
+            self._apply(master, grad, state, _f32(lr), _f32(wd), t)
+            weight._set_data(master._data.astype(weight.dtype))
+        else:
+            self._apply(weight, grad, state, _f32(lr), _f32(wd), t)
+
+    def _apply(self, weight, grad, state, lr, wd, t):
+        raise NotImplementedError
+
+    # common grad preprocessing, traced into each jitted step (rescale is
+    # handled eagerly in _update_one; only the static clip bound bakes in)
+    def _pre(self, g, w=None, wd=None):
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+def _jit_step(fn, n_donate):
+    """jit with weight+state buffers donated (in-place HBM update)."""
+    return jax.jit(fn, donate_argnums=tuple(range(n_donate)))
+
+
+_rescale_jit = jax.jit(lambda g, r: g * r)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum/nesterov (reference: optimizer_op.cc sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.momentum = momentum
+
+        def step(w, mom, g, lr, wd):
+            g = self._pre(g).astype(jnp.float32)
+            wf = w.astype(jnp.float32)
+            g = g + wd * wf
+            mom = self.momentum * mom - lr * g
+            return (wf + mom).astype(w.dtype), mom
+
+        def step_nomom(w, g, lr, wd):
+            g = self._pre(g).astype(jnp.float32)
+            wf = w.astype(jnp.float32)
+            return (wf - lr * (g + wd * wf)).astype(w.dtype)
+
+        self._step = _jit_step(step, 2)
+        self._step_nomom = _jit_step(step_nomom, 1)
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return {}
+        return {"mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        if self.momentum == 0.0:
+            w._set_data(self._step_nomom(w._data, g._data, lr, wd))
+        else:
+            new_w, new_m = self._step(w._data, state["mom"]._data, g._data,
+                                      lr, wd)
+            w._set_data(new_w)
+            state["mom"]._set_data(new_m)
+
+
+@register
+class NAG(Optimizer):
+    """Nesterov accelerated SGD (reference: optimizer_op.cc nag_mom_update)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.momentum = momentum
+
+        def step(w, mom, g, lr, wd):
+            g = self._pre(g) + wd * w
+            mom = self.momentum * mom + g
+            return w - lr * (g + self.momentum * mom), mom
+
+        self._step = _jit_step(step, 2)
+
+    def create_state(self, index, weight):
+        return {"mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, new_m = self._step(w._data, state["mom"]._data, g._data, lr, wd)
+        w._set_data(new_w)
+        state["mom"]._set_data(new_m)
+
+
+class _AdamBase(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, adamw=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.correct_bias = correct_bias
+        b1, b2, eps = beta1, beta2, epsilon
+        decoupled = adamw
+
+        def step(w, m, v, g, lr, wd, t):
+            g = self._pre(g).astype(jnp.float32)
+            wf = w.astype(jnp.float32)
+            if not decoupled:
+                g = g + wd * wf
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            if self.correct_bias:
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+            else:
+                mhat, vhat = m, v
+            upd = mhat / (jnp.sqrt(vhat) + eps)
+            if decoupled:
+                upd = upd + wd * wf
+            return (wf - lr * upd).astype(w.dtype), m, v
+
+        self._step = _jit_step(step, 3)
+
+    def create_state(self, index, weight):
+        return {"mean": NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                "var": NDArray(jnp.zeros(weight.shape, jnp.float32))}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, m, v = self._step(w._data, state["mean"]._data,
+                                 state["var"]._data, g._data, lr, wd,
+                                 _f32(t))
+        w._set_data(new_w)
+        state["mean"]._set_data(m)
+        state["var"]._set_data(v)
+
+
+@register
+class Adam(_AdamBase):
+    """Adam (reference: optimizer_op.cc adam_update)."""
+
+    def __init__(self, learning_rate=0.001, **kwargs):
+        super().__init__(learning_rate, adamw=False, **kwargs)
+
+
+@register
+class AdamW(_AdamBase):
+    """Decoupled weight-decay Adam (reference: contrib adamw.cc)."""
+
+    def __init__(self, learning_rate=0.001, **kwargs):
+        super().__init__(learning_rate, adamw=True, **kwargs)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        b1, b2 = beta1, beta2
+
+        def step(w, m, u, g, lr, wd, t):
+            g = self._pre(g) + wd * w
+            m = b1 * m + (1 - b1) * g
+            u = jnp.maximum(b2 * u, jnp.abs(g))
+            return w - lr / (1 - b1 ** t) * m / (u + 1e-8), m, u
+
+        self._step = _jit_step(step, 3)
+
+    def create_state(self, index, weight):
+        return {"mean": NDArray(jnp.zeros(weight.shape, jnp.float32)),
+                "u": NDArray(jnp.zeros(weight.shape, jnp.float32))}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, m, u = self._step(w._data, state["mean"]._data,
+                                 state["u"]._data, g._data, lr, wd, _f32(t))
+        w._set_data(new_w)
+        state["mean"]._set_data(m)
+        state["u"]._set_data(u)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        b1, b2, eps = beta1, beta2, epsilon
+
+        def step(w, m, v, g, lr, wd, t):
+            g = self._pre(g) + wd * w
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mhat = m / (1 - b1 ** (t + 1))
+            vhat = v / (1 - b2 ** t)
+            upd = (b1 * mhat + (1 - b1) * g / (1 - b1 ** t))
+            return w - lr * upd / (jnp.sqrt(vhat) + eps), m, v
+
+        self._step = _jit_step(step, 3)
+
+    create_state = _AdamBase.create_state
+    _apply = _AdamBase._apply
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (reference: optimizer_op.cc rmsprop_update / rmspropalex)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.centered = centered
+        self.momentum = momentum
+
+        def step(w, n, g_avg, mom, g, lr, wd):
+            g = self._pre(g) + wd * w
+            n = rho * n + (1 - rho) * g * g
+            if centered:
+                g_avg = rho * g_avg + (1 - rho) * g
+                denom = jnp.sqrt(n - g_avg * g_avg + epsilon)
+            else:
+                denom = jnp.sqrt(n + epsilon)
+            if momentum > 0:
+                mom = momentum * mom - lr * g / denom
+                w = w + mom
+            else:
+                w = w - lr * g / denom
+            return w, n, g_avg, mom
+
+        rho = rho
+        self._step = _jit_step(step, 4)
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))  # noqa: E731
+        return {"n": z(), "g": z(), "mom": z()}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, n, ga, mom = self._step(w._data, state["n"]._data,
+                                       state["g"]._data, state["mom"]._data,
+                                       g._data, lr, wd)
+        w._set_data(new_w)
+        state["n"]._set_data(n)
+        state["g"]._set_data(ga)
+        state["mom"]._set_data(mom)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+
+        def step(w, h, g, lr, wd):
+            g = self._pre(g) + wd * w
+            h = h + g * g
+            return w - lr * g / (jnp.sqrt(h) + epsilon), h
+
+        self._step = _jit_step(step, 2)
+
+    def create_state(self, index, weight):
+        return {"history": NDArray(jnp.zeros(weight.shape, jnp.float32))}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, h = self._step(w._data, state["history"]._data, g._data, lr, wd)
+        w._set_data(new_w)
+        state["history"]._set_data(h)
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, learning_rate=1.0, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+
+        def step(w, acc_g, acc_d, g, lr, wd):
+            g = self._pre(g) + wd * w
+            acc_g = rho * acc_g + (1 - rho) * g * g
+            delta = jnp.sqrt(acc_d + epsilon) / jnp.sqrt(acc_g + epsilon) * g
+            acc_d = rho * acc_d + (1 - rho) * delta * delta
+            return w - lr * delta, acc_g, acc_d
+
+        self._step = _jit_step(step, 3)
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))  # noqa: E731
+        return {"acc_g": z(), "acc_delta": z()}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, ag_, ad = self._step(w._data, state["acc_g"]._data,
+                                    state["acc_delta"]._data, g._data, lr, wd)
+        w._set_data(new_w)
+        state["acc_g"]._set_data(ag_)
+        state["acc_delta"]._set_data(ad)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+
+        def step(w, z, n, g, lr, wd):
+            g = self._pre(g)
+            sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+            z = z + g - sigma * w
+            n = n + g * g
+            w = jnp.where(
+                jnp.abs(z) > lamda1,
+                -(z - jnp.sign(z) * lamda1) /
+                ((beta + jnp.sqrt(n)) / lr + wd),
+                0.0)
+            return w, z, n
+
+        self._step = _jit_step(step, 3)
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))  # noqa: E731
+        return {"z": z(), "n": z()}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, z, n = self._step(w._data, state["z"]._data, state["n"]._data,
+                                 g._data, lr, wd)
+        w._set_data(new_w)
+        state["z"]._set_data(z)
+        state["n"]._set_data(n)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        b1, b2, eps = beta1, beta2, epsilon
+
+        def step(w, d, s, z, g, lr, wd, t):
+            g = self._pre(g) + wd * w
+            s = b2 * s + (1 - b2) * g * g
+            sigma_t = jnp.sqrt(s / (1 - b2 ** t)) + eps
+            d_new = (1 - b1 ** t) / lr * sigma_t
+            z = b1 * z + (1 - b1) * g - (d_new - b1 * d) * w
+            return -z / d_new, d_new, s, z
+
+        self._step = _jit_step(step, 4)
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))  # noqa: E731
+        return {"d": z(), "s": z(), "z": z()}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, d, s, z = self._step(w._data, state["d"]._data,
+                                    state["s"]._data, state["z"]._data,
+                                    g._data, lr, wd, _f32(t))
+        w._set_data(new_w)
+        state["d"]._set_data(d)
+        state["s"]._set_data(s)
+        state["z"]._set_data(z)
+
+
+@register
+class Signum(Optimizer):
+    """signSGD with momentum (reference: optimizer_op.cc signum_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.momentum = momentum
+
+        def step(w, mom, g, lr, wd):
+            g = self._pre(g) + wd * w
+            mom = self.momentum * mom - (1 - self.momentum) * g
+            return w + lr * jnp.sign(mom), mom
+
+        def step_nomom(w, g, lr, wd):
+            g = self._pre(g) + wd * w
+            return w - lr * jnp.sign(g)
+
+        self._step = _jit_step(step, 2)
+        self._step_nomom = _jit_step(step_nomom, 1)
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return {}
+        return {"mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        if self.momentum == 0.0:
+            w._set_data(self._step_nomom(w._data, g._data, lr, wd))
+        else:
+            new_w, mom = self._step(w._data, state["mom"]._data, g._data,
+                                    lr, wd)
+            w._set_data(new_w)
+            state["mom"]._set_data(mom)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments (reference: optimizer_op.cc lamb_update_phase1/2)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        b1, b2, eps = beta1, beta2, epsilon
+
+        def step(w, m, v, g, lr, wd, t):
+            g = self._pre(g).astype(jnp.float32)
+            wf = w.astype(jnp.float32)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            if bias_correction:
+                mhat = m / (1 - b1 ** t)
+                vhat = v / (1 - b2 ** t)
+            else:
+                mhat, vhat = m, v
+            r = mhat / (jnp.sqrt(vhat) + eps) + wd * wf
+            w_norm = jnp.linalg.norm(wf)
+            if lower_bound is not None:
+                w_norm = jnp.maximum(w_norm, lower_bound)
+            if upper_bound is not None:
+                w_norm = jnp.minimum(w_norm, upper_bound)
+            r_norm = jnp.linalg.norm(r)
+            ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm,
+                              1.0)
+            return (wf - lr * ratio * r).astype(w.dtype), m, v
+
+        self._step = _jit_step(step, 3)
+
+    create_state = _AdamBase.create_state
+    _apply = _AdamBase._apply
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference: optimizer/optimizer.py LARS)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.momentum = momentum
+
+        def step(w, mom, g, lr, wd):
+            g = self._pre(g)
+            w_norm = jnp.linalg.norm(w)
+            g_norm = jnp.linalg.norm(g)
+            trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                              eta * w_norm / (g_norm + wd * w_norm + epsilon),
+                              1.0)
+            g = g + wd * w
+            mom = self.momentum * mom + trust * lr * g
+            return w - mom, mom
+
+        self._step = _jit_step(step, 2)
+
+    def create_state(self, index, weight):
+        return {"mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
+
+    _apply = NAG._apply
+
+
+@register
+class AdaBelief(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        b1, b2, eps = beta1, beta2, epsilon
+
+        def step(w, m, v, g, lr, wd, t):
+            g = self._pre(g) + wd * w
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * jnp.square(g - m) + eps
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            return w - lr * mhat / (jnp.sqrt(vhat) + eps), m, v
+
+        self._step = _jit_step(step, 3)
+
+    create_state = _AdamBase.create_state
+    _apply = _AdamBase._apply
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: sgld_update)."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+
+    def _apply(self, w, g, state, lr, wd, t):
+        from .. import random as _random
+
+        noise = jax.random.normal(_random._next_key(), w.shape) * \
+            jnp.sqrt(lr)
+        gd = self._pre(g._data) + wd * w._data
+        w._set_data(w._data - lr / 2 * gd + noise.astype(w.dtype))
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: dcasgd update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+        def step(w, prev_w, mom, g, lr, wd):
+            g = self._pre(g) + wd * w
+            g = g + self.lamda * g * g * (w - prev_w)
+            mom = self.momentum * mom - lr * g
+            return w + mom, w, mom
+
+        self._step = _jit_step(step, 3)
+
+    def create_state(self, index, weight):
+        # independent copy: prev must not alias the (donated) weight buffer
+        return {"prev": NDArray(jnp.array(weight._data, copy=True)),
+                "mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, prev, mom = self._step(w._data, state["prev"]._data,
+                                      state["mom"]._data, g._data, lr, wd)
+        w._set_data(new_w)
+        state["prev"]._set_data(prev)
+        state["mom"]._set_data(mom)
+
+
+# common aliases used in reference scripts
+_registry.alias("sgd", "sgd")
+_registry.alias("adam", "adam")
+_registry.alias("adamw", "adamw")

@@ -1,0 +1,51 @@
+"""Updater: closure applying an optimizer keyed by index.
+
+Reference: python/mxnet/optimizer/updater.py — used by KVStore's
+``update_on_kvstore`` path (server-side optimizer) and by Module-style code.
+"""
+from __future__ import annotations
+
+from .optimizer import Optimizer
+
+__all__ = ["Updater", "get_updater"]
+
+
+class Updater:
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if isinstance(index, (list, tuple)):
+            for i, g, w in zip(index, grad, weight):
+                self._one(i, g, w)
+        else:
+            self._one(index, grad, weight)
+
+    def _one(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        state = {k: {n: v.asnumpy() for n, v in s.items()}
+                 for k, s in self.states.items()}
+        return pickle.dumps((state, self.optimizer)
+                            if dump_optimizer else state)
+
+    def set_states(self, states):
+        import pickle
+        from ..ndarray.ndarray import NDArray
+
+        data = pickle.loads(states)
+        if isinstance(data, tuple):
+            data, self.optimizer = data
+        self.states = {k: {n: NDArray(v) for n, v in s.items()}
+                       for k, s in data.items()}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

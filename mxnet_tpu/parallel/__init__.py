@@ -1,0 +1,23 @@
+"""mxnet_tpu.parallel — SPMD scale-out over TPU meshes.
+
+This package is the TPU-native answer to everything the reference does with
+NCCL + ps-lite (SURVEY §2.2, §5.8): instead of push/pull of gradients between
+processes, the WHOLE training step is one pjit-compiled SPMD program over a
+``jax.sharding.Mesh`` whose collectives ride ICI/DCN. Axes follow the
+scaling-book convention: ``dp`` (data), ``tp`` (tensor/model), ``pp``
+(pipeline), ``sp`` (sequence/context), ``ep`` (expert).
+
+- mesh.py        — mesh construction + sharding helpers
+- collectives.py — psum/all_gather/ppermute wrappers for shard_map kernels
+- learner.py     — Learner: gluon Block -> jitted sharded train step
+"""
+from .mesh import (make_mesh, default_mesh, replicated, shard_batch,
+                   shard_params, AxisNames)
+from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
+                          axis_index, axis_size)
+from .learner import Learner, to_optax
+
+__all__ = ["make_mesh", "default_mesh", "replicated", "shard_batch",
+           "shard_params", "AxisNames", "all_reduce", "all_gather",
+           "reduce_scatter", "ppermute", "axis_index", "axis_size",
+           "Learner", "to_optax"]

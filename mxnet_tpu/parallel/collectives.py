@@ -1,0 +1,50 @@
+"""Collective wrappers for use inside shard_map'ed kernels.
+
+Reference mapping (SURVEY §5.8): ncclReduce/ncclBcast (kvstore_nccl.h:285,402)
+and ps-lite push/pull become XLA collectives over ICI/DCN. These helpers are
+thin names over jax.lax so framework code and user kernels share a vocabulary.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
+           "all_to_all", "axis_index", "axis_size"]
+
+
+def all_reduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def all_gather(x, axis_name, axis=0, tiled=False):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)

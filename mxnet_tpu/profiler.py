@@ -1,0 +1,104 @@
+"""Profiler: mx.profiler API over the JAX/XLA profiler.
+
+Reference: python/mxnet/profiler.py (set_config:34, start/stop, dump:125) over
+src/profiler/ (chrome://tracing JSON, aggregate stats). TPU-native mapping:
+``start``/``stop`` drive jax.profiler traces (xplane, viewable in
+TensorBoard/Perfetto); ``scope``/``record`` map to jax.profiler annotations;
+the aggregate-table UX is preserved via ``dumps()`` summarizing named ranges
+timed on host.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+__all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
+           "scope", "record", "Profiler"]
+
+_config = {"profile_all": False, "filename": "profile.json",
+           "aggregate_stats": False}
+_trace_dir = None
+_running = False
+_ranges = {}  # name -> [total_s, count]
+
+
+def set_config(**kwargs):
+    """reference parity: profile_symbolic/profile_imperative/... accepted."""
+    _config.update(kwargs)
+
+
+def start(profile_process="worker"):
+    global _running, _trace_dir
+    if _running:
+        return
+    import jax
+
+    _trace_dir = _config.get("trace_dir") or \
+        os.path.splitext(_config["filename"])[0] + "_xplane"
+    jax.profiler.start_trace(_trace_dir)
+    _running = True
+
+
+def stop(profile_process="worker"):
+    global _running
+    if not _running:
+        return
+    import jax
+
+    jax.profiler.stop_trace()
+    _running = False
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    if _running:
+        stop()
+
+
+def dumps(reset=False, format="table"):
+    """Aggregate stats table (reference: aggregate_stats.cc UX)."""
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+    for name, (total, count) in sorted(_ranges.items()):
+        lines.append(f"{name:<40}{count:>8}{total * 1e3:>12.3f}"
+                     f"{total * 1e3 / count:>12.3f}")
+    if reset:
+        _ranges.clear()
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def scope(name="<unk>"):
+    """Named profiling scope; shows up in xplane and the aggregate table."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    dt = time.perf_counter() - t0
+    tot, cnt = _ranges.get(name, (0.0, 0))
+    _ranges[name] = (tot + dt, cnt + 1)
+
+
+record = scope
+
+
+class Profiler:
+    """Context-manager style profiler (gluon-era API)."""
+
+    def __init__(self, **kwargs):
+        set_config(**kwargs)
+
+    def __enter__(self):
+        start()
+        return self
+
+    def __exit__(self, *exc):
+        stop()

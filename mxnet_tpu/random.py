@@ -1,0 +1,230 @@
+"""Stateful random number interface over JAX's functional PRNG.
+
+The reference keeps per-device Philox/MT generator states as engine resources
+(src/common/random_generator.*, include/mxnet/resource.h:94; python surface
+mx.random / mx.np.random). TPU-native design: one process-global threefry key
+that is split on every draw — stateful at the API, functional underneath so
+every sample is reproducible from ``mx.random.seed(n)`` and every compiled op
+receives an explicit key operand.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as onp
+
+__all__ = ["seed", "uniform", "normal", "randint", "randn", "rand",
+           "choice", "shuffle", "permutation", "multinomial", "bernoulli",
+           "gamma", "beta", "exponential", "poisson", "laplace", "gumbel",
+           "logistic", "pareto", "power", "rayleigh", "weibull", "chisquare",
+           "lognormal", "multivariate_normal"]
+
+_lock = threading.Lock()
+_key = jax.random.PRNGKey(0)
+
+# host-side RNG for data-pipeline augmentation (vision transforms): seeded
+# together with the device PRNG so mx.random.seed makes augmentation
+# reproducible (reference: per-device + per-host generator seeding)
+host_rng = onp.random.RandomState(0)
+
+
+def seed(seed_state: int):
+    """Set the global seed (reference: mx.random.seed)."""
+    global _key
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state))
+        host_rng.seed(int(seed_state) & 0x7FFFFFFF)
+
+
+def _next_key():
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+def _wrap(data, ctx=None, out=None):
+    from .ndarray.ndarray import NDArray
+
+    arr = NDArray(data)
+    if ctx is not None:
+        arr = arr.as_in_ctx(ctx)
+    if out is not None:
+        out._set_data(arr._data)
+        return out
+    return arr
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype="float32", ctx=None,
+            device=None, out=None):
+    data = jax.random.uniform(_next_key(), _shape(size), dtype=_f(dtype),
+                              minval=low, maxval=high)
+    return _wrap(data, device or ctx, out)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype="float32", ctx=None,
+           device=None, out=None):
+    data = jax.random.normal(_next_key(), _shape(size), dtype=_f(dtype))
+    return _wrap(data * scale + loc, device or ctx, out)
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype="float32", ctx=None,
+              out=None):
+    import jax.numpy as jnp
+
+    data = jax.random.normal(_next_key(), _shape(size), dtype=_f(dtype))
+    return _wrap(jnp.exp(data * sigma + mean), ctx, out)
+
+
+def randn(*size, dtype="float32", ctx=None):
+    return normal(0.0, 1.0, size or None, dtype, ctx)
+
+
+def rand(*size, dtype="float32", ctx=None):
+    return uniform(0.0, 1.0, size or None, dtype, ctx)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None, device=None,
+            out=None):
+    if high is None:
+        low, high = 0, low
+    dt = "int32" if str(dtype) in ("int64", "int32", "int") else str(dtype)
+    data = jax.random.randint(_next_key(), _shape(size), low, high, dtype=dt)
+    return _wrap(data, device or ctx, out)
+
+
+def bernoulli(prob=0.5, size=None, dtype="float32", ctx=None):
+    data = jax.random.bernoulli(_next_key(), prob, _shape(size))
+    return _wrap(data.astype(_f(dtype) if "float" in str(dtype) else dtype), ctx)
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    from .ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    if isinstance(a, NDArray):
+        a = a._data
+    elif isinstance(a, int):
+        a = jnp.arange(a)
+    if p is not None:
+        p = p._data if isinstance(p, NDArray) else jnp.asarray(p)
+    data = jax.random.choice(_next_key(), a, _shape(size), replace=replace, p=p)
+    return _wrap(data, ctx, out)
+
+
+def permutation(x, ctx=None):
+    from .ndarray.ndarray import NDArray
+
+    arr = x._data if isinstance(x, NDArray) else x
+    return _wrap(jax.random.permutation(_next_key(), arr), ctx)
+
+
+def shuffle(x):
+    """In-place shuffle along the first axis (reference: mx.random.shuffle)."""
+    x._set_data(jax.random.permutation(_next_key(), x._data))
+    return x
+
+
+def multinomial(n=1, pvals=None, size=None, ctx=None):
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray
+
+    pv = pvals._data if isinstance(pvals, NDArray) else jnp.asarray(pvals)
+    draws = jax.random.categorical(
+        _next_key(), jnp.log(pv), shape=_shape(size) + (n,))
+    counts = jax.nn.one_hot(draws, pv.shape[-1], dtype=jnp.int32).sum(-2)
+    return _wrap(counts, ctx)
+
+
+def categorical(logits, size=None, ctx=None):
+    from .ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    lg = logits._data if isinstance(logits, NDArray) else jnp.asarray(logits)
+    shape = _shape(size) if size is not None else None
+    return _wrap(jax.random.categorical(_next_key(), lg, shape=shape), ctx)
+
+
+def _simple(fn_name):
+    def sampler(*params, size=None, dtype="float32", ctx=None, out=None, **kw):
+        import jax.numpy as jnp
+        from .ndarray.ndarray import NDArray
+
+        params = tuple(p._data if isinstance(p, NDArray) else p for p in params)
+        fn = getattr(jax.random, fn_name)
+        shape = _shape(size)
+        if fn_name == "gamma":
+            data = fn(_next_key(), params[0], shape or None, dtype=_f(dtype))
+            if len(params) > 1:  # scale
+                data = data * params[1]
+        elif fn_name == "beta":
+            data = fn(_next_key(), params[0], params[1], shape or None,
+                      dtype=_f(dtype))
+        elif fn_name == "exponential":
+            data = fn(_next_key(), shape, dtype=_f(dtype))
+            if params:
+                data = data * params[0]  # scale
+        elif fn_name == "poisson":
+            data = fn(_next_key(), params[0] if params else 1.0, shape or None)
+        elif fn_name in ("pareto", "chisquare"):
+            data = fn(_next_key(), params[0], shape or None, dtype=_f(dtype))
+        elif fn_name == "rayleigh":
+            data = jax.random.rayleigh(_next_key(), shape, dtype=_f(dtype))
+            if params:
+                data = data * params[0]
+        elif fn_name == "weibull":
+            data = jax.random.weibull_min(
+                _next_key(), 1.0, params[0] if params else 1.0, shape)
+        else:
+            data = fn(_next_key(), shape, dtype=_f(dtype))
+            if fn_name in ("laplace", "gumbel", "logistic") and params:
+                loc = params[0]
+                scale = params[1] if len(params) > 1 else 1.0
+                data = data * scale + loc
+        return _wrap(data, ctx, out)
+
+    return sampler
+
+
+gamma = _simple("gamma")
+beta = _simple("beta")
+exponential = _simple("exponential")
+poisson = _simple("poisson")
+laplace = _simple("laplace")
+gumbel = _simple("gumbel")
+logistic = _simple("logistic")
+pareto = _simple("pareto")
+rayleigh = _simple("rayleigh")
+weibull = _simple("weibull")
+chisquare = _simple("chisquare")
+
+
+def power(a, size=None, ctx=None):
+    u = jax.random.uniform(_next_key(), _shape(size))
+    return _wrap(u ** (1.0 / a), ctx)
+
+
+def multivariate_normal(mean, cov, size=None, ctx=None):
+    from .ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    m = mean._data if isinstance(mean, NDArray) else jnp.asarray(mean)
+    c = cov._data if isinstance(cov, NDArray) else jnp.asarray(cov)
+    data = jax.random.multivariate_normal(_next_key(), m, c, _shape(size) or None)
+    return _wrap(data, ctx)
+
+
+def _f(dtype):
+    d = str(dtype)
+    return {"float32": onp.float32, "float64": onp.float32,
+            "float16": onp.float16, "bfloat16": "bfloat16",
+            "None": onp.float32}.get(d, onp.float32)

@@ -1,0 +1,4 @@
+"""Symbol package (graph IR + symbolic composition API)."""
+from .symbol import Symbol, SymNode, Literal, var, Variable, topo_sort
+
+__all__ = ["Symbol", "SymNode", "Literal", "var", "Variable", "topo_sort"]

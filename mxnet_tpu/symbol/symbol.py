@@ -1,0 +1,269 @@
+"""Symbol: the captured-graph IR.
+
+TPU-native replacement for nnvm::Symbol/Graph (reference: 3rdparty/tvm/nnvm,
+python/mxnet/symbol/symbol.py). A Symbol is a DAG of :class:`SymNode`s over
+registered ops; it is produced either by deferred-compute tracing of imperative
+code (reference: DCInfo, src/c_api/c_api_ndarray.cc:421-450 — how Gluon 2.0
+hybridization captures graphs) or by composing symbolic placeholders directly
+(``sym.var`` + op calls). CachedOp compiles a Symbol into a single ``jax.jit``
+program, so the reference's nnvm passes (shape/type inference, memory planning,
+pointwise fusion — src/nnvm/) all collapse into XLA compilation.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+
+from ..base import MXNetError
+from ..ops.registry import get_op
+
+__all__ = ["SymNode", "Symbol", "var", "Literal"]
+
+_seq = itertools.count()
+
+
+class Literal:
+    """Non-array operand captured during tracing (python scalar etc.)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
+class SymNode:
+    """One graph node: an op application, a variable, or a constant."""
+
+    __slots__ = ("op", "attrs", "inputs", "name", "value", "seq", "nout")
+
+    def __init__(self, op=None, attrs=None, inputs=(), name=None, value=None,
+                 nout=1):
+        self.op = op            # registry.Op, or None for var/const
+        self.attrs = attrs or {}
+        self.inputs = tuple(inputs)  # entries: (SymNode, out_idx) | Literal
+        self.name = name
+        self.value = value      # jax.Array for const nodes
+        self.seq = next(_seq)
+        self.nout = nout
+
+    @property
+    def is_var(self):
+        return self.op is None and self.value is None
+
+    @property
+    def is_const(self):
+        return self.op is None and self.value is not None
+
+    def __repr__(self):
+        if self.is_var:
+            return f"Var({self.name})"
+        if self.is_const:
+            return f"Const{tuple(self.value.shape)}"
+        return f"Node({self.op.name})"
+
+
+def topo_sort(entries):
+    """Post-order DFS over the graph reachable from output entries."""
+    seen, order = set(), []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for e in node.inputs:
+            if not isinstance(e, Literal):
+                visit(e[0])
+        order.append(node)
+
+    for node, _ in entries:
+        visit(node)
+    return order
+
+
+class Symbol:
+    """User-facing handle over one or more graph output entries.
+
+    Parity surface with the reference Symbol (python/mxnet/symbol/symbol.py):
+    composition via registered ops, ``list_arguments``, ``infer_shape``,
+    ``tojson``/``load``, indexing for multi-output symbols.
+    """
+
+    def __init__(self, entries):
+        self._entries = list(entries)  # [(SymNode, out_idx)]
+
+    # -- composition --------------------------------------------------------
+    @staticmethod
+    def _entry_of(x):
+        if isinstance(x, Symbol):
+            if len(x._entries) != 1:
+                raise MXNetError("cannot use a multi-output symbol as an input")
+            return x._entries[0]
+        return Literal(x)
+
+    @classmethod
+    def apply_op(cls, op_name, *inputs, nout=1, **attrs):
+        op = get_op(op_name)
+        entries = [cls._entry_of(x) for x in inputs]
+        node = SymNode(op=op, attrs=attrs, inputs=entries, nout=nout)
+        return cls([(node, i) for i in range(nout)])
+
+    def __getitem__(self, i):
+        return Symbol([self._entries[i]])
+
+    def __len__(self):
+        return len(self._entries)
+
+    @property
+    def name(self):
+        node, _ = self._entries[0]
+        return node.name or f"node{node.seq}"
+
+    # arithmetic sugar
+    def __add__(self, o):
+        return Symbol.apply_op("add", self, o)
+
+    def __sub__(self, o):
+        return Symbol.apply_op("subtract", self, o)
+
+    def __mul__(self, o):
+        return Symbol.apply_op("multiply", self, o)
+
+    def __truediv__(self, o):
+        return Symbol.apply_op("true_divide", self, o)
+
+    def __pow__(self, o):
+        return Symbol.apply_op("power", self, o)
+
+    def __neg__(self):
+        return Symbol.apply_op("negative", self)
+
+    # -- introspection ------------------------------------------------------
+    def list_arguments(self):
+        return [n.name for n in topo_sort(self._entries) if n.is_var]
+
+    def list_outputs(self):
+        return [f"{n.name or 'node%d' % n.seq}_output{i}"
+                for n, i in self._entries]
+
+    def get_internals(self):
+        nodes = topo_sort(self._entries)
+        return Symbol([(n, 0) for n in nodes])
+
+    def infer_shape(self, **kwargs):
+        """Shape inference via jax.eval_shape over the compiled executor.
+
+        Reference: Symbol.infer_shape (symbol.py:1074) / nnvm InferShape pass.
+        kwargs: name -> shape for each variable.
+        """
+        import jax
+        import jax.numpy as jnp
+        from ..cached_op import build_executor
+
+        var_nodes = [n for n in topo_sort(self._entries) if n.is_var]
+        specs = []
+        for n in var_nodes:
+            if n.name not in kwargs:
+                raise MXNetError(f"infer_shape: missing shape for '{n.name}'")
+            specs.append(jax.ShapeDtypeStruct(tuple(kwargs[n.name]),
+                                              jnp.float32))
+        fn, uses_rng = build_executor(self._entries, var_nodes)
+        if uses_rng:
+            key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            out = jax.eval_shape(fn, key, *specs)
+        else:
+            out = jax.eval_shape(fn, *specs)
+        arg_shapes = [tuple(s.shape) for s in specs]
+        out_shapes = [tuple(o.shape) for o in out]
+        return arg_shapes, out_shapes, []
+
+    # -- serialization ------------------------------------------------------
+    def tojson(self):
+        """Serialize to a JSON graph (reference: Symbol.tojson / save)."""
+        nodes = topo_sort(self._entries)
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            if n.is_var:
+                jnodes.append({"op": "null", "name": n.name or f"var{n.seq}",
+                               "inputs": []})
+            elif n.is_const:
+                import numpy as onp
+
+                jnodes.append({"op": "_const",
+                               "name": f"const{n.seq}",
+                               "value": onp.asarray(n.value).tolist(),
+                               "dtype": str(n.value.dtype),
+                               "inputs": []})
+            else:
+                ins = []
+                for e in n.inputs:
+                    if isinstance(e, Literal):
+                        ins.append({"literal": e.value})
+                    else:
+                        ins.append([idx[id(e[0])], e[1]])
+                jnodes.append({"op": n.op.name, "name": n.name or f"n{n.seq}",
+                               "attrs": _json_attrs(n.attrs), "inputs": ins})
+        heads = [[idx[id(n)], i] for n, i in self._entries]
+        return json.dumps({"nodes": jnodes, "heads": heads,
+                           "mxnet_tpu_version": 1}, indent=1)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    @staticmethod
+    def fromjson(s: str) -> "Symbol":
+        import jax.numpy as jnp
+
+        g = json.loads(s)
+        nodes = []
+        for jn in g["nodes"]:
+            if jn["op"] == "null":
+                nodes.append(SymNode(name=jn["name"]))
+            elif jn["op"] == "_const":
+                nodes.append(SymNode(value=jnp.asarray(
+                    jn["value"], dtype=jn["dtype"])))
+            else:
+                ins = []
+                for e in jn["inputs"]:
+                    if isinstance(e, dict):
+                        ins.append(Literal(e["literal"]))
+                    else:
+                        ins.append((nodes[e[0]], e[1]))
+                attrs = _unjson_attrs(jn.get("attrs", {}))
+                nodes.append(SymNode(op=get_op(jn["op"]), attrs=attrs,
+                                     inputs=ins, name=jn.get("name")))
+        return Symbol([(nodes[i], j) for i, j in g["heads"]])
+
+    @staticmethod
+    def load(fname) -> "Symbol":
+        with open(fname) as f:
+            return Symbol.fromjson(f.read())
+
+
+def _json_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, tuple):
+            v = {"__tuple__": [x for x in v]}
+        out[k] = v
+    return out
+
+
+def _unjson_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, dict) and "__tuple__" in v:
+            v = tuple(v["__tuple__"])
+        if isinstance(v, list):
+            v = tuple(v)
+        out[k] = v
+    return out
+
+
+def var(name, shape=None, dtype=None, **kw):
+    """Create a free variable symbol (reference: sym.var / sym.Variable)."""
+    return Symbol([(SymNode(name=name), 0)])
+
+
+Variable = var

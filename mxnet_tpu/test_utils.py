@@ -1,0 +1,124 @@
+"""Test utilities (reference: python/mxnet/test_utils.py).
+
+Ports the reference's oracle helpers: assert_almost_equal with per-dtype
+tolerances (:655), check_numeric_gradient — finite differences vs autograd
+(:1043), and environment() (:2358). check_consistency's cross-context oracle
+maps to comparing against numpy on host.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import numpy as onp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .context import cpu, tpu, current_context
+
+__all__ = ["assert_almost_equal", "check_numeric_gradient", "default_context",
+           "environment", "rand_ndarray", "same", "almost_equal"]
+
+_DTYPE_TOL = {
+    onp.dtype(onp.float16): (1e-2, 1e-2),
+    onp.dtype(onp.float32): (1e-4, 1e-5),
+    onp.dtype(onp.float64): (1e-4, 1e-5),  # computed in f32 on TPU
+}
+
+
+def default_context():
+    return current_context()
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def same(a, b):
+    return onp.array_equal(_to_np(a), _to_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    a, b = _to_np(a), _to_np(b)
+    rtol = rtol or 1e-5
+    atol = atol or 1e-8
+    return onp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b")):
+    a, b = _to_np(a), _to_np(b)
+    if rtol is None or atol is None:
+        dt = onp.dtype(a.dtype) if a.dtype != object else onp.dtype("float32")
+        drtol, datol = _DTYPE_TOL.get(dt, (1e-4, 1e-5))
+        rtol = rtol if rtol is not None else drtol
+        atol = atol if atol is not None else datol
+    if a.shape != b.shape:
+        raise AssertionError(f"shape mismatch {names[0]}{a.shape} vs "
+                             f"{names[1]}{b.shape}")
+    if not onp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True):
+        diff = onp.abs(a.astype("float64") - b.astype("float64"))
+        rel = diff / (onp.abs(b).astype("float64") + atol)
+        raise AssertionError(
+            f"{names[0]} != {names[1]} (rtol={rtol}, atol={atol}): "
+            f"max abs diff {diff.max():.3e}, max rel {rel.max():.3e}")
+
+
+def rand_ndarray(shape, dtype="float32", low=-1.0, high=1.0):
+    data = onp.random.uniform(low, high, size=shape).astype(dtype)
+    return NDArray(data)
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-2, rtol=3e-2, atol=2e-2):
+    """Finite-difference gradient check of autograd (reference: :1043).
+
+    fn: callable(list[NDArray]) -> scalar NDArray. All inputs get grads.
+    """
+    import jax.numpy as jnp
+
+    from . import autograd
+
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(inputs)
+    out.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for i, x in enumerate(inputs):
+        # order='C' copy: asnumpy() may hand back a Fortran-ordered view of
+        # the device buffer, whose .ravel() would silently copy
+        base = onp.array(x.asnumpy(), dtype="float64", order="C")
+        num = onp.zeros_like(base)
+        for j in range(base.size):
+            orig = base.flat[j]
+            for sign in (+1, -1):
+                base.flat[j] = orig + sign * eps
+                x._set_data(jnp.asarray(base.astype("float32")))
+                val = float(fn(inputs).item())
+                num.flat[j] += sign * val / (2 * eps)
+            base.flat[j] = orig
+        x._set_data(jnp.asarray(base.astype("float32")))
+        if not onp.allclose(analytic[i], num, rtol=rtol, atol=atol):
+            diff = onp.abs(analytic[i] - num).max()
+            raise AssertionError(
+                f"gradient mismatch for input {i}: max diff {diff:.4e}\n"
+                f"analytic={analytic[i]}\nnumeric={num}")
+
+
+@contextlib.contextmanager
+def environment(key, value):
+    """Temporarily set an env var (reference: :2358)."""
+    old = os.environ.get(key)
+    if value is None:
+        os.environ.pop(key, None)
+    else:
+        os.environ[key] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = old

@@ -1,0 +1,79 @@
+"""Utility switches (reference: python/mxnet/util.py).
+
+NumPy semantics (np_shape/np_array) are ALWAYS on in this framework — the
+legacy 1.x shape semantics (0 meaning unknown) never existed here. The
+functions are kept so reference scripts run unchanged.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+__all__ = ["is_np_shape", "is_np_array", "set_np", "set_np_shape", "use_np",
+           "np_shape", "np_array", "getenv", "setenv", "default_array"]
+
+
+def is_np_shape():
+    return True
+
+
+def is_np_array():
+    return True
+
+
+def set_np(shape=True, array=True, dtype=False):
+    return True
+
+
+def set_np_shape(active=True):
+    return True
+
+
+def reset_np():
+    return True
+
+
+@contextlib.contextmanager
+def np_shape(active=True):
+    yield
+
+
+@contextlib.contextmanager
+def np_array(active=True):
+    yield
+
+
+def use_np(func):
+    return func
+
+
+use_np_array = use_np
+use_np_shape = use_np
+
+
+def getenv(name):
+    import os
+
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    import os
+
+    os.environ[name] = value
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from .ndarray.ndarray import array
+
+    return array(source_array, dtype=dtype, ctx=ctx)
+
+
+def wrap_ctx_to_device_func(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        if "ctx" in kwargs and "device" not in kwargs:
+            kwargs["device"] = kwargs.pop("ctx")
+        return func(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,52 @@
+"""Test fixtures (reference: conftest.py — seed fixture :75-97,
+module_scope_waitall :61).
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware (the driver separately dry-runs them).
+"""
+import os
+
+# force CPU: the suite runs against a virtual 8-device mesh regardless of the
+# ambient platform (the real-TPU path is exercised by bench.py and the
+# driver's __graft_entry__ checks). jax may already be imported (and the env
+# var consumed) by a site hook, so set the config directly too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def seed_everything(request):
+    """Reproducible seeds per test, logged on failure (reference pattern)."""
+    seed = onp.random.randint(0, 2 ** 31)
+    marker = request.node.get_closest_marker("seed")
+    if marker is not None:
+        seed = marker.args[0]
+    onp.random.seed(seed)
+    import mxnet_tpu as mx
+
+    mx.random.seed(seed)
+    yield seed
+
+
+@pytest.fixture(scope="module", autouse=True)
+def module_scope_waitall():
+    yield
+    import mxnet_tpu as mx
+
+    mx.waitall()
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "seed(n): fix the RNG seed for a test")
+    config.addinivalue_line("markers", "serial: run in isolation")
+    config.addinivalue_line("markers", "integration: end-to-end tests")

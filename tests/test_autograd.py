@@ -1,0 +1,202 @@
+"""Autograd semantics + numeric-gradient oracle (reference:
+tests/python/unittest/test_autograd.py, test_higher_order_grad.py pattern)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, rand_ndarray)
+
+
+def test_simple_grad():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_grad():
+    x = np.array([0.5, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = np.exp(np.sin(x)).sum()
+    y.backward()
+    ref = onp.exp(onp.sin(x.asnumpy())) * onp.cos(x.asnumpy())
+    assert_almost_equal(x.grad, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_input_grad():
+    a = np.array([1.0, 2.0])
+    b = np.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = (a * b + a).sum()
+    y.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_grad_accumulation_modes():
+    x = np.array([2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, [12.0])  # 3 * 2x
+
+    x2 = np.array([2.0])
+    x2.attach_grad()  # write
+    for _ in range(3):
+        with autograd.record():
+            y = (x2 * x2).sum()
+        y.backward()
+    assert_almost_equal(x2.grad, [4.0])
+
+
+def test_head_grads():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(np.array([1.0, 10.0]))
+    assert_almost_equal(x.grad, [3.0, 30.0])
+
+
+def test_autograd_grad_api():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    g = autograd.grad(y, [x])[0]
+    assert_almost_equal(g, 3 * x.asnumpy() ** 2)
+    # grads NOT accumulated into x.grad by grad()
+    assert_almost_equal(x.grad, [0.0, 0.0])
+
+
+def test_detach_stops_grad():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y.detach() * x).sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_stop_gradient_op():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (npx.stop_gradient(x * 2) * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_no_record_no_grad():
+    x = np.array([1.0])
+    x.attach_grad()
+    y = x * 2
+    with pytest.raises(MXNetError):
+        y.backward()
+
+
+def test_mark_variables():
+    x = np.array([3.0])
+    g = np.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(g, [6.0])
+
+
+def test_training_flags():
+    assert not autograd.is_training()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_inplace_on_recorded_raises():
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(MXNetError):
+            y += 1
+
+
+@pytest.mark.parametrize("case", [
+    "sum_square", "matmul", "softmax_ce", "reduce_max", "broadcast"])
+def test_numeric_gradient(case):
+    if case == "sum_square":
+        check_numeric_gradient(lambda xs: (xs[0] * xs[0]).sum(),
+                               [rand_ndarray((3, 2))])
+    elif case == "matmul":
+        check_numeric_gradient(
+            lambda xs: (xs[0] @ xs[1]).sum(),
+            [rand_ndarray((2, 3)), rand_ndarray((3, 2))])
+    elif case == "softmax_ce":
+        y = np.array([0, 2])
+
+        def f(xs):
+            return -(npx.log_softmax(xs[0]) *
+                     np.one_hot(y, 4)).sum()
+
+        check_numeric_gradient(f, [rand_ndarray((2, 4))])
+    elif case == "reduce_max":
+        check_numeric_gradient(lambda xs: xs[0].max(axis=1).sum(),
+                               [rand_ndarray((3, 4))])
+    elif case == "broadcast":
+        check_numeric_gradient(
+            lambda xs: (xs[0] + xs[1]).sum(),
+            [rand_ndarray((3, 4)), rand_ndarray((4,))])
+
+
+def test_grad_through_indexing():
+    x = rand_ndarray((4, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = (x[1:3] * 2).sum()
+    y.backward()
+    expected = onp.zeros((4, 3), "float32")
+    expected[1:3] = 2
+    assert_almost_equal(x.grad, expected)
+
+
+def test_grad_through_concat_split():
+    a = rand_ndarray((2, 3))
+    b = rand_ndarray((2, 3))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = np.concatenate([a, b], axis=0)
+        top, bottom = np.split(c, 2, axis=0)
+        loss = (top * 1 + bottom * 2).sum()
+    loss.backward()
+    assert_almost_equal(a.grad, onp.ones((2, 3)))
+    assert_almost_equal(b.grad, 2 * onp.ones((2, 3)))
+
+
+def test_exception_at_sync():
+    # invalid op surfaces as MXNetError at call or sync point (reference:
+    # test_exc_handling.py semantics)
+    with pytest.raises(Exception):
+        a = np.ones((2, 3))
+        b = np.ones((4, 5))
+        c = a @ b  # shape mismatch
+        c.wait_to_read()

@@ -1,0 +1,147 @@
+"""Deferred compute + CachedOp (reference: test_deferred_compute.py,
+CachedOp paths in src/imperative/cached_op.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.cached_op import trace, CachedOp
+from mxnet_tpu.symbol import Symbol
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_trace_and_replay():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    w = np.array([[1.0, 0.0], [0.0, 1.0]])
+
+    def fn(a):
+        return (a @ w + 1).sum(axis=1)
+
+    tree, flat, cop = trace(fn, [x], [("w", w)])
+    y1 = cop(np.array([[5.0, 6.0], [7.0, 8.0]]), w)
+    ref = (onp.array([[5.0, 6.0], [7.0, 8.0]]) + 1).sum(axis=1)
+    assert_almost_equal(y1, ref)
+
+
+def test_const_capture():
+    x = np.array([1.0, 2.0])
+
+    def fn(a):
+        c = np.array([10.0, 20.0])  # created inside forward -> const node
+        return a + c
+
+    _, _, cop = trace(fn, [x], [])
+    out = cop(np.array([1.0, 1.0]))
+    assert_almost_equal(out, [11.0, 21.0])
+
+
+def test_multi_output():
+    x = np.array([[1.0, 2.0]])
+
+    def fn(a):
+        return a * 2, a + 1
+
+    tree, flat, cop = trace(fn, [x], [])
+    o1, o2 = cop(x)
+    assert_almost_equal(o1, [[2.0, 4.0]])
+    assert_almost_equal(o2, [[2.0, 3.0]])
+
+
+def test_cached_op_autograd():
+    x = np.array([1.0, 2.0, 3.0])
+
+    def fn(a):
+        return (a * a).sum()
+
+    _, _, cop = trace(fn, [x], [])
+    inp = np.array([2.0, 3.0, 4.0])
+    inp.attach_grad()
+    with autograd.record():
+        y = cop(inp)
+    y.backward()
+    assert_almost_equal(inp.grad, 2 * inp.asnumpy())
+
+
+def test_rng_fresh_per_call():
+    x = np.ones((50, 50))
+
+    def fn(a):
+        with autograd.train_mode():
+            return npx.dropout(a, p=0.5)
+
+    _, _, cop = trace(fn, [x], [])
+    a = cop(x).asnumpy()
+    b = cop(x).asnumpy()
+    assert not onp.allclose(a, b), "dropout mask must differ per call"
+
+
+def test_symbol_json_roundtrip():
+    x = np.array([[1.0, 2.0]])
+
+    def fn(a):
+        return npx.activation(a * 2 + 1, act_type="relu")
+
+    _, _, cop = trace(fn, [x], [])
+    js = cop.sym.tojson()
+    sym2 = Symbol.fromjson(js)
+    from mxnet_tpu.symbol.symbol import topo_sort
+
+    var_nodes = [n for n in topo_sort(sym2._entries) if n.is_var]
+    cop2 = CachedOp(sym2, var_nodes)
+    assert_almost_equal(cop2(x), cop(x))
+
+
+def test_symbol_infer_shape():
+    import mxnet_tpu.symbol as sym
+
+    a = sym.var("a")
+    b = sym.var("b")
+    c = Symbol.apply_op("matmul", a, b)
+    arg_shapes, out_shapes, _ = c.infer_shape(a=(2, 3), b=(3, 5))
+    assert out_shapes[0] == (2, 5)
+
+
+def test_symbol_list_arguments():
+    import mxnet_tpu.symbol as sym
+
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a + b) * a
+    args = c.list_arguments()
+    assert set(args) == {"a", "b"}
+
+
+def test_trace_rejects_boolean_mask():
+    x = np.array([1.0, -1.0, 2.0])
+
+    def fn(a):
+        return a[a > 0]
+
+    with pytest.raises(MXNetError):
+        trace(fn, [x], [])
+
+
+def test_nested_hybrid_blocks_inline():
+    from mxnet_tpu.gluon import nn
+
+    inner = nn.Dense(4, in_units=3)
+    outer = nn.HybridSequential()
+    outer.add(inner, nn.Dense(2, in_units=4))
+    outer.initialize()
+    inner.hybridize()
+    outer.hybridize()
+    x = mx.np.random.uniform(size=(2, 3))
+    out = outer(x)
+    assert out.shape == (2, 2)
+
+
+def test_lower_hlo():
+    x = np.ones((2, 2))
+
+    def fn(a):
+        return a + 1
+
+    _, _, cop = trace(fn, [x], [])
+    hlo = cop.lower_hlo(x)
+    assert "stablehlo" in hlo or "module" in hlo

@@ -1,0 +1,119 @@
+"""Control-flow ops (reference: tests for _foreach/_while_loop/_cond,
+src/operator/control_flow.cc) — lowered to lax.scan/while/cond."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_foreach_cumsum():
+    data = np.array([[1.0], [2.0], [3.0]])
+
+    def body(x, states):
+        acc = states[0] + x
+        return acc, [acc]
+
+    outs, final = npx.foreach(body, data, [np.zeros((1,))])
+    assert_almost_equal(outs, [[1.0], [3.0], [6.0]])
+    assert_almost_equal(final[0], [6.0])
+
+
+def test_foreach_grad_through_states():
+    data = np.array([1.0, 2.0, 3.0]).reshape((3, 1))
+    data.attach_grad()
+
+    def body(x, states):
+        acc = states[0] + x * x
+        return acc, [acc]
+
+    with autograd.record():
+        outs, final = npx.foreach(body, data, [np.zeros((1,))])
+        loss = final[0].sum()
+    loss.backward()
+    assert_almost_equal(data.grad, 2 * data.asnumpy())
+
+
+def test_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def body(i, s):
+        return None, (i + 1, s + i)
+
+    _, (i_f, s_f) = npx.while_loop(cond, body,
+                                   (np.array(0.0), np.array(0.0)))
+    assert float(i_f) == 5
+    assert float(s_f) == 10  # 0+1+2+3+4
+
+
+def test_while_loop_with_outputs():
+    def cond(i):
+        return i < 3
+
+    def body(i):
+        return i * 2, (i + 1,)
+
+    outs, (i_f,) = npx.while_loop(cond, body, (np.array(1.0),),
+                                  max_iterations=5)
+    assert float(i_f) == 3
+    assert outs.asnumpy()[:2].tolist() == [2.0, 4.0]
+    assert outs.asnumpy()[2:].tolist() == [0.0, 0.0, 0.0]  # padded
+
+
+def test_cond():
+    x = np.array([1.0, 2.0])
+
+    out = npx.cond(np.array(True),
+                   lambda a: a * 2,
+                   lambda a: a * 3,
+                   inputs=[x])
+    assert_almost_equal(out, [2.0, 4.0])
+    out = npx.cond(np.array(False),
+                   lambda a: a * 2,
+                   lambda a: a * 3,
+                   inputs=[x])
+    assert_almost_equal(out, [3.0, 6.0])
+
+
+def test_cond_grad():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        out = npx.cond(np.array(True), lambda a: (a * a).sum(),
+                       lambda a: a.sum(), inputs=[x])
+    out.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_foreach_multi_state():
+    data = np.arange(4).reshape((4, 1)).astype("float32")
+
+    def body(x, states):
+        s1, s2 = states
+        return x + s1, [s1 + 1, s2 * 1.1]
+
+    outs, (s1, s2) = npx.foreach(body, data, [np.zeros((1,)),
+                                              np.ones((1,))])
+    assert outs.shape == (4, 1)
+    assert float(s1) == 4
+
+
+def test_foreach_inside_hybridize():
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.block import HybridBlock
+
+    class ScanNet(HybridBlock):
+        def forward(self, x):
+            def body(t, states):
+                return t * 2, [states[0] + t]
+
+            outs, final = npx.foreach(body, x, [np.zeros(x.shape[1:])])
+            return outs + final[0]
+
+    net = ScanNet()
+    net.hybridize()
+    x = np.array([[1.0], [2.0]])
+    out = net(x)
+    assert_almost_equal(out, [[2.0 + 3.0], [4.0 + 3.0]])

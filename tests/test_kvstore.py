@@ -1,0 +1,115 @@
+"""KVStore semantics (reference: tests/python/unittest/test_kvstore.py,
+test_kvstore_custom.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore, np, optimizer
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_create_types():
+    for name in ("local", "device", "nccl", "dist_sync"):
+        kv = kvstore.create(name)
+        assert kv.rank == 0
+        assert kv.num_workers == 1
+    with pytest.raises(MXNetError):
+        kvstore.create("dist_async")
+    with pytest.raises(MXNetError):
+        kvstore.create("bogus")
+
+
+def test_init_push_pull():
+    kv = kvstore.create("local")
+    kv.init("w", np.array([1.0, 2.0]))
+    out = np.zeros((2,))
+    kv.pull("w", out=out)
+    assert_almost_equal(out, [1.0, 2.0])
+    kv.push("w", np.array([5.0, 5.0]))
+    kv.pull("w", out=out)
+    assert_almost_equal(out, [5.0, 5.0])
+
+
+def test_push_multi_value_sums():
+    kv = kvstore.create("device")
+    kv.init(0, np.zeros((2,)))
+    kv.push(0, [np.array([1.0, 1.0]), np.array([2.0, 2.0]),
+                np.array([3.0, 3.0])])
+    out = np.zeros((2,))
+    kv.pull(0, out=out)
+    assert_almost_equal(out, [6.0, 6.0])
+
+
+def test_pushpull_fused():
+    kv = kvstore.create("device")
+    g = np.array([1.0, 2.0])
+    out = np.zeros((2,))
+    kv.pushpull("k", g, out=out)
+    assert_almost_equal(out, [1.0, 2.0])
+
+
+def test_list_keys():
+    kv = kvstore.create("local")
+    keys = ["a", "b"]
+    kv.init(keys, [np.ones((2,)), np.full((2,), 2.0)])
+    outs = [np.zeros((2,)), np.zeros((2,))]
+    kv.pull(keys, out=outs)
+    assert_almost_equal(outs[0], [1.0, 1.0])
+    assert_almost_equal(outs[1], [2.0, 2.0])
+
+
+def test_update_on_kvstore():
+    kv = kvstore.create("local")
+    kv.set_optimizer(optimizer.SGD(learning_rate=0.1))
+    w = np.array([1.0, 1.0])
+    kv.init("w", w)
+    grad = np.array([1.0, 1.0])
+    out = np.array([1.0, 1.0])
+    kv.pushpull("w", grad, out=out)
+    assert_almost_equal(out, [0.9, 0.9])
+
+
+def test_broadcast():
+    kv = kvstore.create("local")
+    out = np.zeros((3,))
+    kv.broadcast("b", np.array([1.0, 2.0, 3.0]), out=out)
+    assert_almost_equal(out, [1.0, 2.0, 3.0])
+
+
+def test_optimizer_states_roundtrip(tmp_path):
+    kv = kvstore.create("local")
+    kv.set_optimizer(optimizer.Adam())
+    kv.init("w", np.ones((2,)))
+    out = np.ones((2,))
+    kv.pushpull("w", np.array([0.1, 0.1]), out=out)
+    f = str(tmp_path / "states.bin")
+    kv.save_optimizer_states(f)
+    kv.load_optimizer_states(f)
+
+
+def test_custom_backend_registry():
+    from mxnet_tpu.kvstore import KVStoreBase
+
+    @KVStoreBase.register
+    class MyStore(kvstore.KVStore):
+        pass
+
+    assert KVStoreBase.get_kvstore_class("mystore") is MyStore
+
+
+def test_trainer_with_kvstore():
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="device")
+    x = np.ones((4, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(4)
+    assert not onp.allclose(w_before, net.weight.data().asnumpy())

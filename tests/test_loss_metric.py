@@ -1,0 +1,139 @@
+"""Losses and metrics (reference: test_loss.py, test_metric.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, gluon, metric
+from mxnet_tpu.gluon import loss as gloss
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_l2_loss():
+    pred = np.array([[1.0, 2.0], [3.0, 4.0]])
+    label = np.array([[1.5, 2.0], [3.0, 3.0]])
+    out = gloss.L2Loss()(pred, label)
+    ref = ((label.asnumpy() - pred.asnumpy()) ** 2 / 2).mean(axis=1)
+    assert_almost_equal(out, ref)
+
+
+def test_l1_loss():
+    pred = np.array([[1.0, -2.0]])
+    label = np.array([[0.0, 0.0]])
+    out = gloss.L1Loss()(pred, label)
+    assert_almost_equal(out, [1.5])
+
+
+def test_softmax_ce_sparse():
+    pred = np.array([[5.0, 0.0, 0.0], [0.0, 5.0, 0.0]])
+    label = np.array([0, 1])
+    out = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    p = onp.exp(5.0) / (onp.exp(5.0) + 2)
+    assert_almost_equal(out, [-onp.log(p)] * 2, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_ce_dense():
+    pred = np.array([[1.0, 2.0, 3.0]])
+    label = np.array([[0.0, 0.0, 1.0]])
+    out = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(pred, label)
+    logp = pred.asnumpy() - onp.log(onp.exp(pred.asnumpy()).sum())
+    assert_almost_equal(out, [-logp[0, 2]], rtol=1e-4, atol=1e-5)
+
+
+def test_sigmoid_bce():
+    pred = np.array([[0.0, 2.0]])
+    label = np.array([[0.0, 1.0]])
+    out = gloss.SigmoidBCELoss()(pred, label)
+    x, z = pred.asnumpy(), label.asnumpy()
+    ref = (onp.maximum(x, 0) - x * z + onp.log1p(onp.exp(-onp.abs(x)))) \
+        .mean(axis=1)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_kl_hinge_huber():
+    pred = np.array([[0.1, -0.5]])
+    label = np.array([[1.0, -1.0]])
+    assert gloss.HingeLoss()(pred, label).shape == (1,)
+    assert gloss.SquaredHingeLoss()(pred, label).shape == (1,)
+    assert gloss.HuberLoss()(pred, label).shape == (1,)
+    assert gloss.LogisticLoss()(pred, label).shape == (1,)
+
+
+def test_ctc_loss_shape():
+    T, B, V = 10, 2, 5
+    pred = mx.np.random.uniform(size=(B, T, V))
+    label = np.array([[1, 2, 0, 0], [2, 3, 4, 0]])
+    out = gloss.CTCLoss()(pred, label,
+                          pred_lengths=np.array([10, 10]),
+                          label_lengths=np.array([2, 3]))
+    assert out.shape == (B,)
+    assert (out.asnumpy() > 0).all()
+
+
+def test_triplet_cosine():
+    a = mx.np.random.uniform(size=(2, 4))
+    p = mx.np.random.uniform(size=(2, 4))
+    n = mx.np.random.uniform(size=(2, 4))
+    assert gloss.TripletLoss()(a, p, n).shape == (2,)
+    lbl = np.array([1, -1])
+    assert gloss.CosineEmbeddingLoss()(a, p, lbl).shape == (2,)
+
+
+# ---------------------------------------------------------------- metrics
+def test_accuracy():
+    m = metric.Accuracy()
+    m.update(np.array([0, 1, 1]), np.array([[0.9, 0.1], [0.2, 0.8],
+                                            [0.7, 0.3]]))
+    name, acc = m.get()
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk_accuracy():
+    m = metric.TopKAccuracy(top_k=2)
+    m.update(np.array([2]), np.array([[0.3, 0.1, 0.2]]))
+    _, acc = m.get()
+    assert acc == 1.0
+
+
+def test_f1_mcc():
+    m = metric.F1()
+    m.update(np.array([1, 0, 1, 1]), np.array([0.9, 0.2, 0.8, 0.1]))
+    _, f1 = m.get()
+    assert 0 < f1 <= 1
+    mcc = metric.MCC()
+    mcc.update(np.array([1, 0, 1, 1]), np.array([0.9, 0.2, 0.8, 0.1]))
+    _, v = mcc.get()
+    assert -1 <= v <= 1
+
+
+def test_mae_mse_rmse():
+    label = np.array([1.0, 2.0])
+    pred = np.array([1.5, 2.5])
+    m = metric.MAE()
+    m.update(label, pred)
+    assert abs(m.get()[1] - 0.5) < 1e-6
+    m = metric.MSE()
+    m.update(label, pred)
+    assert abs(m.get()[1] - 0.25) < 1e-6
+    m = metric.RMSE()
+    m.update(label, pred)
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_perplexity():
+    m = metric.Perplexity()
+    m.update(np.array([0]), np.array([[1.0, 0.0]]))
+    _, p = m.get()
+    assert abs(p - 1.0) < 1e-4
+
+
+def test_composite_and_create():
+    m = metric.create(["mse", "mae"])
+    m.update(np.array([1.0, 2.0]), np.array([1.5, 2.5]))
+    names, values = m.get()
+    assert len(names) == 2
+
+
+def test_custom_metric():
+    m = metric.create(lambda label, pred: float(onp.sum(label == pred)))
+    m.update(np.array([1, 2]), np.array([1, 3]))
+    assert m.get()[1] == 1.0

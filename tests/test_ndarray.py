@@ -1,0 +1,171 @@
+"""NDArray core semantics (reference: tests/python/unittest/test_ndarray.py,
+test_numpy_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = np.array([[1, 2], [3, 4]], dtype="float32")
+    assert a.shape == (2, 2)
+    assert str(a.dtype) == "float32"
+    assert a.size == 4
+    assert a.ndim == 2
+    assert np.zeros((3, 4)).asnumpy().sum() == 0
+    assert np.ones((3, 4)).asnumpy().sum() == 12
+    assert np.full((2, 2), 7).asnumpy().tolist() == [[7, 7], [7, 7]]
+    assert np.arange(5).asnumpy().tolist() == [0, 1, 2, 3, 4]
+    assert np.eye(3).asnumpy().trace() == 3
+    ls = np.linspace(0, 1, 5)
+    assert_almost_equal(ls, onp.linspace(0, 1, 5, dtype="float32"))
+
+
+def test_float64_canonicalized():
+    a = np.array(onp.ones(3, dtype="float64"))
+    assert str(a.dtype) == "float32"
+
+
+def test_arithmetic_and_broadcast():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.array([10.0, 20.0])
+    assert_almost_equal(a + b, onp.array([[11, 22], [13, 24]], "float32"))
+    assert_almost_equal(a * 2, a.asnumpy() * 2)
+    assert_almost_equal(2 - a, 2 - a.asnumpy())
+    assert_almost_equal(a / b, a.asnumpy() / b.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(a @ a, a.asnumpy() @ a.asnumpy())
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(np.array([-1.0, 2.0])), [1.0, 2.0])
+
+
+def test_comparison_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([2.0, 2.0, 2.0])
+    assert (a < b).asnumpy().tolist() == [True, False, False]
+    assert (a == b).asnumpy().tolist() == [False, True, False]
+    assert (a >= b).asnumpy().tolist() == [False, True, True]
+
+
+def test_inplace_ops():
+    a = np.array([1.0, 2.0])
+    orig = a
+    a += 1
+    assert a is orig
+    assert a.asnumpy().tolist() == [2.0, 3.0]
+    a *= 2
+    assert a.asnumpy().tolist() == [4.0, 6.0]
+
+
+def test_indexing_basic():
+    a = np.arange(24).reshape((2, 3, 4))
+    npa = onp.arange(24).reshape(2, 3, 4)
+    assert_almost_equal(a[0], npa[0])
+    assert_almost_equal(a[1, 2], npa[1, 2])
+    assert_almost_equal(a[:, 1], npa[:, 1])
+    assert_almost_equal(a[..., -1], npa[..., -1])
+    assert_almost_equal(a[0, :, None], npa[0, :, None])
+    assert_almost_equal(a[::-1], npa[::-1])
+
+
+def test_indexing_advanced():
+    a = np.arange(12).reshape((3, 4))
+    npa = onp.arange(12).reshape(3, 4)
+    idx = np.array([0, 2])
+    assert_almost_equal(a[idx], npa[[0, 2]])
+    mask = np.array([True, False, True])
+    assert_almost_equal(a[mask], npa[onp.array([True, False, True])])
+
+
+def test_setitem():
+    a = np.zeros((3, 3))
+    a[1] = 5.0
+    assert a.asnumpy()[1].tolist() == [5.0, 5.0, 5.0]
+    a[0, 0] = 1.0
+    assert a.asnumpy()[0, 0] == 1.0
+    a[:, 2] = np.array([7.0, 8.0, 9.0])
+    assert a.asnumpy()[:, 2].tolist() == [7.0, 8.0, 9.0]
+
+
+def test_scalar_conversion():
+    a = np.array([3.5])
+    assert float(a) == 3.5
+    assert int(np.array([3])) == 3
+    assert bool(np.array([1]))
+    with pytest.raises(ValueError):
+        bool(np.array([1, 2]))
+
+
+def test_iteration_len():
+    a = np.arange(6).reshape((3, 2))
+    rows = [r.asnumpy().tolist() for r in a]
+    assert rows == [[0, 1], [2, 3], [4, 5]]
+    assert len(a) == 3
+
+
+def test_astype_copy():
+    a = np.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.asnumpy().tolist() == [1, 2]
+    c = a.copy()
+    c += 1
+    assert a.asnumpy().tolist() == [1.5, 2.5]
+
+
+def test_copyto_and_ctx():
+    a = np.array([1.0, 2.0])
+    b = np.zeros((2,))
+    a.copyto(b)
+    assert b.asnumpy().tolist() == [1.0, 2.0]
+    assert a.ctx.device_type in ("cpu", "tpu")
+    c = a.as_in_ctx(mx.cpu())
+    assert c.ctx.device_type == "cpu"
+
+
+def test_reshape_transpose():
+    a = np.arange(6)
+    assert a.reshape((2, 3)).shape == (2, 3)
+    assert a.reshape(2, 3).shape == (2, 3)
+    assert a.reshape((2, -1)).shape == (2, 3)
+    b = a.reshape((2, 3)).T
+    assert b.shape == (3, 2)
+    assert a.reshape((1, 2, 3)).squeeze(0).shape == (2, 3)
+    assert a.expand_dims(0).shape == (1, 6)
+
+
+def test_reductions_methods():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert float(a.sum()) == 10
+    assert float(a.mean()) == 2.5
+    assert float(a.max()) == 4
+    assert float(a.min()) == 1
+    assert a.sum(axis=0).asnumpy().tolist() == [4.0, 6.0]
+    assert a.argmax(axis=1).asnumpy().tolist() == [1, 1]
+    assert a.sum(axis=1, keepdims=True).shape == (2, 1)
+
+
+def test_wait_and_repr():
+    a = np.ones((2, 2))
+    a.wait_to_read()
+    assert "1." in repr(a)
+    mx.waitall()
+
+
+def test_save_load(tmp_path):
+    from mxnet_tpu import nd
+
+    d = {"w": np.array([1.0, 2.0]), "b": np.array([3.0])}
+    f = str(tmp_path / "params.npz")
+    nd.save(f, d)
+    loaded = nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    assert loaded["w"].asnumpy().tolist() == [1.0, 2.0]
+
+
+def test_dlpack_numpy_interop():
+    a = np.array([1.0, 2.0])
+    arr = onp.asarray(a)
+    assert arr.tolist() == [1.0, 2.0]

@@ -1,0 +1,190 @@
+"""Op-vs-NumPy oracle (reference pattern: tests/python/unittest/
+test_numpy_op.py — every op checked against the NumPy reference)."""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import np
+from mxnet_tpu.test_utils import assert_almost_equal
+
+UNARY_CASES = [
+    ("abs", onp.abs, (-2, 2)), ("exp", onp.exp, (-2, 2)),
+    ("log", onp.log, (0.1, 3)), ("sqrt", onp.sqrt, (0.1, 3)),
+    ("square", onp.square, (-2, 2)), ("sin", onp.sin, (-3, 3)),
+    ("cos", onp.cos, (-3, 3)), ("tanh", onp.tanh, (-2, 2)),
+    ("floor", onp.floor, (-3, 3)), ("ceil", onp.ceil, (-3, 3)),
+    ("sign", onp.sign, (-2, 2)), ("log1p", onp.log1p, (0, 2)),
+    ("expm1", onp.expm1, (-1, 1)), ("arctan", onp.arctan, (-2, 2)),
+    ("sinh", onp.sinh, (-2, 2)), ("cosh", onp.cosh, (-2, 2)),
+    ("arcsin", onp.arcsin, (-0.9, 0.9)), ("cbrt", onp.cbrt, (-2, 2)),
+    ("reciprocal", onp.reciprocal, (0.5, 2)),
+]
+
+
+@pytest.mark.parametrize("name,ref,rng", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_vs_numpy(name, ref, rng):
+    x = onp.random.uniform(rng[0], rng[1], size=(3, 4)).astype("float32")
+    got = getattr(np, name)(np.array(x))
+    assert_almost_equal(got, ref(x).astype("float32"), rtol=1e-4, atol=1e-5)
+
+
+BINARY_CASES = ["add", "subtract", "multiply", "true_divide", "power",
+                "maximum", "minimum", "arctan2", "hypot", "logaddexp"]
+
+
+@pytest.mark.parametrize("name", BINARY_CASES)
+def test_binary_vs_numpy(name):
+    a = onp.random.uniform(0.5, 2, size=(3, 4)).astype("float32")
+    b = onp.random.uniform(0.5, 2, size=(4,)).astype("float32")
+    got = getattr(np, name)(np.array(a), np.array(b))
+    ref = getattr(onp, name)(a, b)
+    assert_almost_equal(got, ref.astype("float32"), rtol=1e-4, atol=1e-5)
+
+
+REDUCE_CASES = ["sum", "mean", "max", "min", "prod", "std", "var"]
+
+
+@pytest.mark.parametrize("name", REDUCE_CASES)
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 1)])
+def test_reduce_vs_numpy(name, axis):
+    x = onp.random.uniform(0.5, 1.5, size=(3, 4)).astype("float32")
+    got = getattr(np, name)(np.array(x), axis=axis)
+    ref = getattr(onp, name)(x, axis=axis)
+    assert_almost_equal(got, onp.asarray(ref, dtype="float32"),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_matmul_dot_einsum():
+    a = onp.random.randn(3, 4).astype("float32")
+    b = onp.random.randn(4, 5).astype("float32")
+    assert_almost_equal(np.matmul(np.array(a), np.array(b)), a @ b,
+                        rtol=1e-4, atol=1e-4)
+    assert_almost_equal(np.dot(np.array(a), np.array(b)), a.dot(b),
+                        rtol=1e-4, atol=1e-4)
+    got = np.einsum("ij,jk->ik", np.array(a), np.array(b))
+    assert_almost_equal(got, onp.einsum("ij,jk->ik", a, b), rtol=1e-4,
+                        atol=1e-4)
+    c = onp.random.randn(2, 3, 4).astype("float32")
+    d = onp.random.randn(2, 4, 5).astype("float32")
+    assert_almost_equal(np.matmul(np.array(c), np.array(d)),
+                        onp.matmul(c, d), rtol=1e-4, atol=1e-4)
+
+
+def test_tensordot():
+    a = onp.random.randn(3, 4, 5).astype("float32")
+    b = onp.random.randn(5, 4, 2).astype("float32")
+    got = np.tensordot(np.array(a), np.array(b), axes=([1, 2], [1, 0]))
+    ref = onp.tensordot(a, b, axes=([1, 2], [1, 0]))
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_shape_manipulation():
+    x = onp.arange(24).reshape(2, 3, 4).astype("float32")
+    mx_x = np.array(x)
+    assert_almost_equal(np.transpose(mx_x, (2, 0, 1)),
+                        onp.transpose(x, (2, 0, 1)))
+    assert_almost_equal(np.swapaxes(mx_x, 0, 2), onp.swapaxes(x, 0, 2))
+    assert_almost_equal(np.moveaxis(mx_x, 0, -1), onp.moveaxis(x, 0, -1))
+    assert_almost_equal(np.tile(mx_x, (2, 1, 1)), onp.tile(x, (2, 1, 1)))
+    assert_almost_equal(np.repeat(mx_x, 2, axis=1), onp.repeat(x, 2, axis=1))
+    assert_almost_equal(np.flip(mx_x, 1), onp.flip(x, 1))
+    assert_almost_equal(np.roll(mx_x, 1, 0), onp.roll(x, 1, 0))
+    assert_almost_equal(np.broadcast_to(np.array([1.0, 2, 3, 4]), (2, 4)),
+                        onp.broadcast_to([1, 2, 3, 4], (2, 4)))
+
+
+def test_concat_stack_split():
+    a = onp.ones((2, 3), "float32")
+    b = onp.zeros((2, 3), "float32")
+    assert_almost_equal(np.concatenate([np.array(a), np.array(b)], axis=0),
+                        onp.concatenate([a, b], axis=0))
+    assert_almost_equal(np.stack([np.array(a), np.array(b)], axis=1),
+                        onp.stack([a, b], axis=1))
+    parts = np.split(np.array(onp.arange(12).reshape(4, 3)), 2, axis=0)
+    assert len(parts) == 2
+    assert parts[0].shape == (2, 3)
+    assert_almost_equal(np.vstack([np.array(a), np.array(b)]),
+                        onp.vstack([a, b]))
+    assert_almost_equal(np.hstack([np.array(a), np.array(b)]),
+                        onp.hstack([a, b]))
+
+
+def test_where_sort_argsort():
+    x = onp.random.randn(4, 5).astype("float32")
+    mx_x = np.array(x)
+    assert_almost_equal(np.where(mx_x > 0, mx_x, np.zeros_like(mx_x)),
+                        onp.where(x > 0, x, 0))
+    assert_almost_equal(np.sort(mx_x, axis=1), onp.sort(x, axis=1))
+    assert_almost_equal(np.argsort(mx_x, axis=1).astype("int64"),
+                        onp.argsort(x, axis=1, kind="stable"))
+
+
+def test_take_pick_onehot():
+    x = onp.random.randn(4, 5).astype("float32")
+    idx = onp.array([0, 2, 4, 1])
+    assert_almost_equal(np.take(np.array(x), np.array(idx), axis=1),
+                        onp.take(x, idx, axis=1))
+    got = np.pick(np.array(x), np.array(idx), axis=1)
+    ref = x[onp.arange(4), idx]
+    assert_almost_equal(got, ref)
+    oh = np.one_hot(np.array([0, 2]), 4)
+    assert oh.asnumpy().tolist() == [[1, 0, 0, 0], [0, 0, 1, 0]]
+
+
+def test_cumsum_diff_clip():
+    x = onp.random.randn(3, 4).astype("float32")
+    assert_almost_equal(np.cumsum(np.array(x), axis=1),
+                        onp.cumsum(x, axis=1), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(np.diff(np.array(x), axis=1), onp.diff(x, axis=1))
+    assert_almost_equal(np.clip(np.array(x), -0.5, 0.5),
+                        onp.clip(x, -0.5, 0.5))
+
+
+def test_linalg():
+    a = onp.random.randn(4, 4).astype("float32")
+    spd = a @ a.T + 4 * onp.eye(4, dtype="float32")
+    assert_almost_equal(np.linalg.inv(np.array(spd)) @ np.array(spd),
+                        onp.eye(4), rtol=1e-3, atol=1e-3)
+    assert_almost_equal(np.linalg.det(np.array(spd)),
+                        onp.linalg.det(spd), rtol=1e-3, atol=1e-2)
+    L = np.linalg.cholesky(np.array(spd))
+    assert_almost_equal(L @ L.T, spd, rtol=1e-3, atol=1e-3)
+    q, r = np.linalg.qr(np.array(a))
+    assert_almost_equal(q @ r, a, rtol=1e-3, atol=1e-3)
+    u, s, vt = np.linalg.svd(np.array(a), full_matrices=False)
+    assert_almost_equal((u * s) @ vt, a, rtol=1e-3, atol=1e-3)
+    b = onp.random.randn(4).astype("float32")
+    x = np.linalg.solve(np.array(spd), np.array(b))
+    assert_almost_equal(np.array(spd) @ x, b, rtol=1e-3, atol=1e-3)
+    w, v = np.linalg.eigh(np.array(spd))
+    assert_almost_equal(np.array(spd) @ v, v * w, rtol=1e-2, atol=1e-2)
+
+
+def test_unique_nonzero_host_fallback():
+    x = np.array([1, 2, 2, 3, 3, 3])
+    u = np.unique(x)
+    assert u.asnumpy().tolist() == [1, 2, 3]
+    nz = np.nonzero(np.array([0, 1, 0, 2]))
+    assert nz[0].asnumpy().tolist() == [1, 3]
+    fnz = np.flatnonzero(np.array([0, 1, 0, 2]), size=2)
+    assert fnz.asnumpy().tolist() == [1, 3]
+
+
+def test_topk():
+    x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    idx = np.topk(x, k=2, axis=1)
+    assert idx.asnumpy().tolist() == [[0, 2], [1, 2]]
+    vals = np.topk(x, k=2, axis=1, ret_typ="value")
+    assert vals.asnumpy().tolist() == [[3.0, 2.0], [5.0, 4.0]]
+    asc = np.topk(x, k=1, axis=1, ret_typ="value", is_ascend=True)
+    assert asc.asnumpy().tolist() == [[1.0], [0.0]]
+
+
+def test_pad_meshgrid():
+    x = onp.ones((2, 2), "float32")
+    assert_almost_equal(np.pad(np.array(x), ((1, 1), (0, 0))),
+                        onp.pad(x, ((1, 1), (0, 0))))
+    g1, g2 = np.meshgrid(np.arange(3), np.arange(2))
+    r1, r2 = onp.meshgrid(onp.arange(3), onp.arange(2))
+    assert_almost_equal(g1.astype("float32"), r1.astype("float32"))
+    assert_almost_equal(g2.astype("float32"), r2.astype("float32"))

@@ -1,0 +1,119 @@
+"""Optimizers (reference: tests/python/unittest/test_optimizer.py —
+update-math checks + convergence on a quadratic)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, optimizer
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.test_utils import assert_almost_equal
+
+ALL_OPTS = ["sgd", "nag", "adam", "adamw", "adamax", "nadam", "rmsprop",
+            "adagrad", "adadelta", "ftrl", "ftml", "signum", "lamb", "lars",
+            "adabelief", "sgld", "dcasgd"]
+
+
+def test_sgd_update_math():
+    opt = optimizer.SGD(learning_rate=0.1)
+    w = NDArray(onp.array([1.0, 2.0], "float32"))
+    g = NDArray(onp.array([0.5, 0.5], "float32"))
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    assert_almost_equal(w, [0.95, 1.95])
+
+
+def test_sgd_momentum_math():
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    w = NDArray(onp.array([1.0], "float32"))
+    g = NDArray(onp.array([1.0], "float32"))
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)  # mom = -0.1; w = 0.9
+    assert_almost_equal(w, [0.9])
+    opt.update(0, w, g, state)  # mom = 0.9*-0.1 - 0.1 = -0.19; w = 0.71
+    assert_almost_equal(w, [0.71])
+
+
+def test_sgd_wd_and_rescale():
+    opt = optimizer.SGD(learning_rate=0.1, wd=0.1, rescale_grad=0.5)
+    w = NDArray(onp.array([1.0], "float32"))
+    g = NDArray(onp.array([2.0], "float32"))
+    opt.update(0, w, g, opt.create_state(0, w))
+    # g_eff = 2*0.5 + 0.1*1 = 1.1 -> w = 1 - 0.11
+    assert_almost_equal(w, [0.89])
+
+
+def test_adam_first_step():
+    opt = optimizer.Adam(learning_rate=0.001)
+    w = NDArray(onp.array([1.0], "float32"))
+    g = NDArray(onp.array([0.5], "float32"))
+    opt.update(0, w, g, opt.create_state(0, w))
+    # first step of adam moves by ~lr regardless of grad magnitude
+    assert_almost_equal(w, [1.0 - 0.001], rtol=1e-3, atol=1e-5)
+
+
+def test_clip_gradient():
+    opt = optimizer.SGD(learning_rate=1.0, clip_gradient=0.1)
+    w = NDArray(onp.array([0.0], "float32"))
+    g = NDArray(onp.array([100.0], "float32"))
+    opt.update(0, w, g, opt.create_state(0, w))
+    assert_almost_equal(w, [-0.1])
+
+
+@pytest.mark.parametrize("name", ALL_OPTS)
+def test_optimizer_minimizes_quadratic(name):
+    kwargs = {"learning_rate": 0.05}
+    if name in ("adam", "adamw", "adamax", "nadam", "adabelief", "lamb",
+                "ftml"):
+        kwargs["learning_rate"] = 0.1
+    if name in ("adagrad", "ftrl"):
+        kwargs["learning_rate"] = 0.5
+    if name == "adadelta":
+        kwargs["learning_rate"] = 1.0
+    if name == "lars":
+        kwargs["learning_rate"] = 10.0  # trust ratio ~ eta*|w|/|g| is tiny
+    if name == "sgld":
+        kwargs["learning_rate"] = 0.01
+    opt = optimizer.create(name, **kwargs)
+    target = onp.array([1.0, -2.0, 3.0], "float32")
+    # start away from zero: norm-scaled optimizers (lamb/lars) freeze at w=0
+    w = NDArray(onp.full(3, 0.5, "float32"))
+    state = opt.create_state(0, w)
+    for _ in range(500):
+        g = NDArray(2 * (w.asnumpy() - target))
+        opt.update(0, w, g, state)
+    err = onp.abs(w.asnumpy() - target).max()
+    tol = 1.5 if name == "sgld" else 0.35
+    assert err < tol, f"{name}: final error {err}"
+
+
+def test_lr_scheduler_integration():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    sched = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    opt = optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = NDArray(onp.array([0.0], "float32"))
+    g = NDArray(onp.array([0.0], "float32"))
+    state = opt.create_state(0, w)
+    for _ in range(25):
+        opt.update(0, w, g, state)
+    assert opt.learning_rate < 1.0
+
+
+def test_multi_precision_state():
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                        multi_precision=True)
+    w = NDArray(onp.array([1.0], "float16"))
+    st = opt.create_state_multi_precision(0, w)
+    assert "weight_fp32" in st
+
+
+def test_updater_roundtrip(tmp_path):
+    opt = optimizer.Adam()
+    upd = optimizer.get_updater(opt)
+    w = NDArray(onp.array([1.0], "float32"))
+    g = NDArray(onp.array([0.1], "float32"))
+    upd(0, g, w)
+    blob = upd.get_states()
+    upd2 = optimizer.get_updater(optimizer.Adam())
+    upd2.set_states(blob)
+    assert 0 in upd2.states

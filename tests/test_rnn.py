@@ -1,0 +1,131 @@
+"""RNN cells & fused layers (reference: test_gluon_rnn.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, autograd
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_lstm_cell_step():
+    cell = rnn.LSTMCell(8)
+    cell.initialize()
+    x = mx.np.random.uniform(size=(4, 6))
+    states = cell.begin_state(4)
+    out, new_states = cell(x, states)
+    assert out.shape == (4, 8)
+    assert new_states[0].shape == (4, 8)
+    assert new_states[1].shape == (4, 8)
+
+
+def test_gru_rnn_cells():
+    for cell in (rnn.GRUCell(5), rnn.RNNCell(5)):
+        cell.initialize()
+        x = mx.np.random.uniform(size=(2, 3))
+        out, states = cell(x, cell.begin_state(2))
+        assert out.shape == (2, 5)
+
+
+def test_cell_unroll():
+    cell = rnn.LSTMCell(4)
+    cell.initialize()
+    inputs = mx.np.random.uniform(size=(2, 5, 3))  # NTC
+    outs, states = cell.unroll(5, inputs, layout="NTC")
+    assert outs.shape == (2, 5, 4)
+
+
+def test_fused_lstm_layer():
+    layer = rnn.LSTM(8, num_layers=2, layout="NTC")
+    layer.initialize()
+    x = mx.np.random.uniform(size=(3, 7, 5))
+    out = layer(x)
+    assert out.shape == (3, 7, 8)
+    states = layer.begin_state(3)
+    out, new_states = layer(x, states)
+    assert new_states[0].shape == (2, 3, 8)
+    assert new_states[1].shape == (2, 3, 8)
+
+
+def test_fused_gru_rnn_layers():
+    for layer, nst in ((rnn.GRU(6, layout="NTC"), 1),
+                       (rnn.RNN(6, layout="NTC"), 1)):
+        layer.initialize()
+        x = mx.np.random.uniform(size=(2, 4, 3))
+        out, states = layer(x, layer.begin_state(2))
+        assert out.shape == (2, 4, 6)
+        assert len(states) == nst
+
+
+def test_bidirectional_lstm():
+    layer = rnn.LSTM(5, bidirectional=True, layout="NTC")
+    layer.initialize()
+    x = mx.np.random.uniform(size=(2, 6, 3))
+    out = layer(x)
+    assert out.shape == (2, 6, 10)
+
+
+def test_tnc_layout():
+    layer = rnn.LSTM(4, layout="TNC")
+    layer.initialize()
+    x = mx.np.random.uniform(size=(7, 2, 3))
+    assert layer(x).shape == (7, 2, 4)
+
+
+def test_fused_matches_cell_unroll():
+    """The fused scan path must agree with stepwise cell execution."""
+    layer = rnn.LSTM(4, layout="NTC")
+    layer.initialize()
+    x = mx.np.random.uniform(size=(2, 5, 3))
+    fused = layer(x).asnumpy()  # also finishes deferred weight init
+    cell = rnn.LSTMCell(4, input_size=3)
+    cell.initialize()
+    cell.i2h_weight.set_data(layer.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(layer.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(layer.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(layer.l0_h2h_bias.data())
+    stepwise, _ = cell.unroll(5, x, layout="NTC")
+    assert_almost_equal(fused, stepwise.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_gradients_flow():
+    layer = rnn.LSTM(4, layout="NTC")
+    layer.initialize()
+    x = mx.np.random.uniform(size=(2, 5, 3))
+    with autograd.record():
+        loss = layer(x).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad()
+    assert float(abs(g).sum()) > 0
+
+
+def test_sequential_cells():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4))
+    stack.add(rnn.LSTMCell(3))
+    stack.initialize()
+    x = mx.np.random.uniform(size=(2, 5))
+    out, states = stack(x, stack.begin_state(2))
+    assert out.shape == (2, 3)
+    assert len(states) == 4
+
+
+def test_dropout_residual_cells():
+    base = rnn.RNNCell(5)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    x = mx.np.random.uniform(size=(2, 5))
+    out, _ = res(x, base.begin_state(2))
+    assert out.shape == (2, 5)
+    dc = rnn.DropoutCell(0.5)
+    out2, _ = dc(x, [])
+    assert out2.shape == (2, 5)
+
+
+def test_bidirectional_cell_unroll():
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(3, input_size=4),
+                               rnn.LSTMCell(3, input_size=4))
+    bi.initialize()
+    x = mx.np.random.uniform(size=(2, 5, 4))
+    out, states = bi.unroll(5, x, layout="NTC")
+    assert out.shape == (2, 5, 6)
