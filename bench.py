@@ -1,32 +1,37 @@
-"""Benchmark: ResNet-50 inference throughput on the local accelerator.
+"""Benchmarks on the local accelerator. Prints ONE JSON line.
 
-Mirrors the reference's headline benchmark
-(example/image-classification/benchmark_score.py; numbers in
-docs/.../faq/perf.md — V100 fp16 batch 128: 2355.04 img/s, BASELINE.md).
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default metric mirrors the reference's headline benchmark
+(example/image-classification/benchmark_score.py; docs/.../faq/perf.md —
+V100 fp16 ResNet-50 batch 128: 2355.04 img/s, BASELINE.md). Select with
+argv[1] or BENCH env: resnet (default) | resnet_train | bert_pretrain.
 """
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
-BASELINE_IMG_S = 2355.04  # V100 fp16, ResNet-50, batch 128 (perf.md:210)
-BATCH = 128
-WARMUP = 3
-ITERS = 10
+import numpy as onp
+
+BASELINE_RESNET_INFER = 2355.04  # V100 fp16 batch 128 (perf.md:210)
+BASELINE_RESNET_TRAIN = 363.69   # V100 fp32 batch 128 training (perf.md:254)
+BASELINE_BERT_TOKENS = 10000.0   # A100-class tokens/sec/chip anchor (BASELINE.md)
 
 
-def main():
-    import jax
+def _sync(data):
+    # device->host readback: the only reliable barrier on every PJRT backend
+    return onp.asarray(data.ravel()[0] if hasattr(data, "ravel") else data)
 
+
+def bench_resnet_infer():
     import mxnet_tpu as mx
-    from mxnet_tpu import amp
     from mxnet_tpu.cached_op import trace
     from mxnet_tpu.gluon.model_zoo import vision
 
+    BATCH, WARMUP, ITERS = 128, 3, 10
     net = vision.resnet50_v1()
     net.initialize()
-    # bf16 everywhere: MXU-native inference precision
     net.cast("bfloat16")
     x = mx.np.zeros((BATCH, 3, 224, 224), dtype="bfloat16")
     params = [(name, p.data())
@@ -34,31 +39,96 @@ def main():
               if p._data is not None]
     _, _, cop = trace(lambda a: net(a), [x], params)
     arrs = [x] + [arr for _, arr in params]
-
-    import numpy as onp
-
-    def sync(arr):
-        # device->host readback: the only reliable barrier on every PJRT
-        # backend (block_until_ready is a no-op on some tunneled platforms)
-        return onp.asarray(arr._data[0, 0])
-
     for _ in range(WARMUP):
-        out = cop(*arrs)
-        sync(out)
-
+        _sync(cop(*arrs)._data)
     t0 = time.perf_counter()
     for _ in range(ITERS):
         out = cop(*arrs)
-    sync(out)
+    _sync(out._data)
     dt = time.perf_counter() - t0
-
     img_s = BATCH * ITERS / dt
-    print(json.dumps({
-        "metric": "resnet50_bf16_infer_batch128",
-        "value": round(img_s, 2),
-        "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-    }))
+    return {"metric": "resnet50_bf16_infer_batch128",
+            "value": round(img_s, 2), "unit": "img/s",
+            "vs_baseline": round(img_s / BASELINE_RESNET_INFER, 3)}
+
+
+def bench_resnet_train():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    from mxnet_tpu import amp
+
+    BATCH, WARMUP, ITERS = 128, 2, 8
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize()
+    amp.init("bfloat16")  # MXU ops run bf16, params/optimizer state fp32
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    learner = parallel.Learner(net, loss_fn,
+                               mx.optimizer.SGD(learning_rate=0.1,
+                                                momentum=0.9))
+    x = mx.np.random.uniform(size=(BATCH, 3, 224, 224)).astype("bfloat16")
+    y = mx.np.random.randint(0, 1000, size=(BATCH,)).astype("float32")
+    for _ in range(WARMUP):
+        _sync(learner.step(x, y)._data)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = learner.step(x, y)
+    _sync(loss._data)
+    dt = time.perf_counter() - t0
+    img_s = BATCH * ITERS / dt
+    return {"metric": "resnet50_train_batch128",
+            "value": round(img_s, 2), "unit": "img/s",
+            "vs_baseline": round(img_s / BASELINE_RESNET_TRAIN, 3)}
+
+
+def bench_bert_pretrain():
+    """BERT-Base MLM+NSP pretraining step, bf16, one chip (config 4)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, gluon, parallel
+    from mxnet_tpu.gluon.model_zoo.bert import bert_base, BERTForPretraining
+
+    B, T, WARMUP, ITERS = 32, 128, 2, 8
+    bert = bert_base(max_length=T, dropout=0.1, dtype="float32")
+    model = BERTForPretraining(bert, vocab_size=30522)
+    model.initialize()
+    amp.convert_hybrid_block(model, "bfloat16")
+    amp.init("bfloat16")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def pretrain_loss(pair, labels):
+        mlm_scores, nsp_scores = pair
+        mlm_labels, nsp_labels = labels[:, :-1], labels[:, -1]
+        return loss_fn(mlm_scores, mlm_labels).mean() + \
+            loss_fn(nsp_scores, nsp_labels).mean()
+
+    learner = parallel.Learner(model, pretrain_loss,
+                               mx.optimizer.AdamW(learning_rate=1e-4,
+                                                  wd=0.01))
+    tokens = mx.np.random.randint(0, 30522, size=(B, T))
+    labels = mx.np.concatenate([
+        mx.np.random.randint(0, 30522, size=(B, T)),
+        mx.np.random.randint(0, 2, size=(B, 1))], axis=1).astype("float32")
+    for _ in range(WARMUP):
+        _sync(learner.step(tokens, labels)._data)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = learner.step(tokens, labels)
+    _sync(loss._data)
+    dt = time.perf_counter() - t0
+    tok_s = B * T * ITERS / dt
+    return {"metric": "bert_base_pretrain_bf16_tokens_per_sec",
+            "value": round(tok_s, 1), "unit": "tokens/s",
+            "vs_baseline": round(tok_s / BASELINE_BERT_TOKENS, 3)}
+
+
+def main():
+    which = (sys.argv[1] if len(sys.argv) > 1 else
+             os.environ.get("BENCH", "resnet"))
+    fn = {"resnet": bench_resnet_infer,
+          "resnet_train": bench_resnet_train,
+          "bert_pretrain": bench_bert_pretrain}[which]
+    print(json.dumps(fn()))
 
 
 if __name__ == "__main__":

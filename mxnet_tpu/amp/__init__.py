@@ -22,7 +22,8 @@ __all__ = ["init", "is_enabled", "target_dtype", "scale_loss", "unscale",
 # ops that benefit from bf16 inputs on the MXU (reference: FP16_FUNCS list)
 MXU_OPS = frozenset({
     "fully_connected", "convolution", "deconvolution", "matmul", "dot",
-    "batch_dot", "einsum", "multihead_attention", "tensordot",
+    "batch_dot", "einsum", "multihead_attention", "flash_attention",
+    "tensordot",
 })
 
 _state = threading.local()
@@ -70,24 +71,6 @@ class autocast:
 
     def __exit__(self, *exc):
         _st().enabled, _st().dtype = self._prev
-
-
-def maybe_cast_inputs(op_name, datas):
-    """Called by the op registry: cast MXU-op operands when AMP is active."""
-    st = _st()
-    if not st.enabled or op_name not in MXU_OPS:
-        return datas
-    import jax.numpy as jnp
-    import numpy as onp
-
-    tgt = jnp.bfloat16 if st.dtype == "bfloat16" else jnp.float16
-    out = []
-    for d in datas:
-        if hasattr(d, "dtype") and d.dtype in (jnp.float32, onp.float32):
-            out.append(d.astype(tgt))
-        else:
-            out.append(d)
-    return out
 
 
 def scale_loss(loss, optimizer_or_trainer):

@@ -1,5 +1,10 @@
 """gluon.model_zoo (reference: python/mxnet/gluon/model_zoo/)."""
 from . import vision
 from .vision import get_model
+from . import bert
+from .bert import bert_base, bert_large, BERTModel, BERTForPretraining
+from . import rnn_lm
+from .rnn_lm import RNNModel
 
-__all__ = ["vision", "get_model"]
+__all__ = ["vision", "get_model", "bert", "bert_base", "bert_large",
+           "BERTModel", "BERTForPretraining", "rnn_lm", "RNNModel"]

@@ -1,0 +1,180 @@
+"""BERT encoder family (north-star config 4: BERT-Base pretraining, bf16,
+fused attention).
+
+The reference ships BERT-oriented kernels (src/operator/contrib/transformer.cc
+interleaved qkv matmuls, nn/layer_norm.*, GELU in leaky_relu) but no model;
+the model definitions lived in gluon-nlp. Here the encoder is a first-class
+zoo member: attention routes through the Pallas flash-attention kernel,
+LayerNorm through the fused row-norm kernel, and under hybridize the whole
+encoder compiles to one XLA program.
+"""
+from __future__ import annotations
+
+import math
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .. import nn
+from ... import numpy_extension as npx
+from ... import np as _np
+from ... import initializer as init_mod
+
+__all__ = ["TransformerEncoderLayer", "BERTEncoder", "BERTModel",
+           "BERTForPretraining", "bert_base", "bert_large"]
+
+
+class TransformerEncoderLayer(HybridBlock):
+    """Post-LN transformer layer (BERT convention)."""
+
+    def __init__(self, units=768, hidden_size=3072, num_heads=12,
+                 dropout=0.1, attention_dropout=0.1, layer_norm_eps=1e-12,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError("units must divide num_heads")
+        self._num_heads = num_heads
+        self.attn_qkv = nn.Dense(3 * units, flatten=False, dtype=dtype,
+                                 weight_initializer=init_mod.Normal(0.02),
+                                 in_units=units)
+        self.attn_proj = nn.Dense(units, flatten=False, dtype=dtype,
+                                  weight_initializer=init_mod.Normal(0.02),
+                                  in_units=units)
+        self.attn_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.ffn_1 = nn.Dense(hidden_size, flatten=False, dtype=dtype,
+                              weight_initializer=init_mod.Normal(0.02),
+                              in_units=units)
+        self.ffn_2 = nn.Dense(units, flatten=False, dtype=dtype,
+                              weight_initializer=init_mod.Normal(0.02),
+                              in_units=hidden_size)
+        self.ffn_ln = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self._dropout = dropout
+
+    def forward(self, x, mask=None):
+        qkv = self.attn_qkv(x)
+        units = qkv.shape[-1] // 3
+        q = npx.slice_axis(qkv, axis=-1, begin=0, end=units)
+        k = npx.slice_axis(qkv, axis=-1, begin=units, end=2 * units)
+        v = npx.slice_axis(qkv, axis=-1, begin=2 * units, end=3 * units)
+        if mask is not None:
+            attn = npx.multihead_attention(q, k, v, mask=mask,
+                                           num_heads=self._num_heads)
+        else:
+            attn = npx.multihead_attention(q, k, v,
+                                           num_heads=self._num_heads)
+        attn = self.attn_proj(attn)
+        if self._dropout:
+            attn = npx.dropout(attn, p=self._dropout)
+        x = self.attn_ln(x + attn)
+        ffn = self.ffn_2(npx.leaky_relu(self.ffn_1(x), act_type="gelu"))
+        if self._dropout:
+            ffn = npx.dropout(ffn, p=self._dropout)
+        return self.ffn_ln(x + ffn)
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, layer_norm_eps=1e-12,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self.layers = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(TransformerEncoderLayer(
+                units, hidden_size, num_heads, dropout,
+                layer_norm_eps=layer_norm_eps, dtype=dtype))
+
+    def forward(self, x, mask=None):
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Embeddings + encoder + pooler (reference architecture: BERT)."""
+
+    def __init__(self, vocab_size=30522, num_layers=12, units=768,
+                 hidden_size=3072, num_heads=12, max_length=512,
+                 type_vocab_size=2, dropout=0.1, layer_norm_eps=1e-12,
+                 dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        self.units = units
+        self.word_embed = nn.Embedding(vocab_size, units, dtype=dtype,
+                                       weight_initializer=init_mod.Normal(
+                                           0.02))
+        self.token_type_embed = nn.Embedding(type_vocab_size, units,
+                                             dtype=dtype)
+        self.position_embed = Parameter(shape=(max_length, units),
+                                        dtype=dtype,
+                                        init=init_mod.Normal(0.02))
+        self.embed_ln = nn.LayerNorm(epsilon=layer_norm_eps,
+                                     in_channels=units)
+        self._dropout = dropout
+        self.encoder = BERTEncoder(num_layers, units, hidden_size, num_heads,
+                                   dropout, layer_norm_eps, dtype)
+        self.pooler = nn.Dense(units, flatten=False, activation="tanh",
+                               in_units=units, dtype=dtype)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        T = inputs.shape[1]
+        x = self.word_embed(inputs)
+        if token_types is not None:
+            x = x + self.token_type_embed(token_types)
+        pos = self.position_embed.data()[:T]
+        x = x + pos.expand_dims(0)
+        x = self.embed_ln(x)
+        if self._dropout:
+            x = npx.dropout(x, p=self._dropout)
+        mask = None
+        if valid_length is not None:
+            # (B, 1, 1, T) key-padding mask broadcast over heads and queries
+            idx = _np.arange(T)
+            mask = (idx.expand_dims(0) <
+                    valid_length.reshape((-1, 1))).astype("float32")
+            mask = mask.reshape((-1, 1, 1, T))
+        seq = self.encoder(x, mask)
+        pooled = self.pooler(npx.slice_axis(seq, axis=1, begin=0, end=1)
+                             .reshape((-1, self.units)))
+        return seq, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM + NSP heads over BERTModel (pretraining objective)."""
+
+    def __init__(self, bert: BERTModel, vocab_size=30522, **kwargs):
+        super().__init__(**kwargs)
+        self.bert = bert
+        units = bert.units
+        self.mlm_transform = nn.Dense(units, flatten=False, in_units=units)
+        self.mlm_ln = nn.LayerNorm(in_channels=units)
+        self.mlm_decoder_bias = Parameter(shape=(vocab_size,), init="zeros")
+        self.nsp_classifier = nn.Dense(2, in_units=units)
+
+    def forward(self, inputs, token_types=None, valid_length=None):
+        seq, pooled = self.bert(inputs, token_types, valid_length)
+        h = npx.leaky_relu(self.mlm_transform(seq), act_type="gelu")
+        h = self.mlm_ln(h)
+        # decoder ties the word-embedding matrix (standard BERT weight tying)
+        w = self.bert.word_embed.weight.data()
+        mlm_scores = _np.matmul(h, w.T) + self.mlm_decoder_bias.data()
+        nsp_scores = self.nsp_classifier(pooled)
+        return mlm_scores, nsp_scores
+
+
+_SPECS = {
+    "base": dict(num_layers=12, units=768, hidden_size=3072, num_heads=12),
+    "large": dict(num_layers=24, units=1024, hidden_size=4096,
+                  num_heads=16),
+}
+
+
+def bert_base(vocab_size=30522, max_length=512, dropout=0.1,
+              dtype="float32", **kwargs):
+    return BERTModel(vocab_size=vocab_size, max_length=max_length,
+                     dropout=dropout, dtype=dtype, **_SPECS["base"], **kwargs)
+
+
+def bert_large(vocab_size=30522, max_length=512, dropout=0.1,
+               dtype="float32", **kwargs):
+    return BERTModel(vocab_size=vocab_size, max_length=max_length,
+                     dropout=dropout, dtype=dtype, **_SPECS["large"],
+                     **kwargs)

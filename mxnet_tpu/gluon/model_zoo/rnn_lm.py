@@ -1,0 +1,54 @@
+"""LSTM language model (north-star config 3: PTB LM, reference:
+example/rnn/word_lm). Embedding -> fused scan LSTM stack -> tied decoder."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from .. import nn
+from .. import rnn
+from ... import numpy_extension as npx
+from ... import np as _np
+
+__all__ = ["RNNModel", "rnn_lm"]
+
+
+class RNNModel(HybridBlock):
+    def __init__(self, vocab_size=10000, embed_size=200, hidden_size=200,
+                 num_layers=2, dropout=0.2, tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        self._dropout = dropout
+        self.embedding = nn.Embedding(vocab_size, embed_size)
+        self.lstm = rnn.LSTM(hidden_size, num_layers=num_layers,
+                             layout="NTC", dropout=dropout)
+        self._tie = tie_weights and embed_size == hidden_size
+        if not self._tie:
+            self.decoder = nn.Dense(vocab_size, flatten=False,
+                                    in_units=hidden_size)
+        self.hidden_size = hidden_size
+
+    def begin_state(self, batch_size):
+        return self.lstm.begin_state(batch_size)
+
+    def forward(self, inputs, states=None):
+        # inputs: (N, T) int tokens
+        x = self.embedding(inputs)
+        if self._dropout:
+            x = npx.dropout(x, p=self._dropout)
+        if states is None:
+            out = self.lstm(x)
+            new_states = None
+        else:
+            out, new_states = self.lstm(x, states)
+        if self._dropout:
+            out = npx.dropout(out, p=self._dropout)
+        if self._tie:
+            w = self.embedding.weight.data()
+            logits = _np.matmul(out, w.T)
+        else:
+            logits = self.decoder(out)
+        if states is None:
+            return logits
+        return logits, new_states
+
+
+def rnn_lm(**kwargs):
+    return RNNModel(**kwargs)

@@ -174,10 +174,12 @@ class NDArray:
 
     # ----------------------------------------------------------- conversion
     def astype(self, dtype, copy=True):
+        from ..base import dtype_name
+
         dtype = canonical_dtype(dtype)
         if not copy and self.dtype == dtype:
             return self
-        return apply_op("astype", self, dtype=str(dtype))
+        return apply_op("astype", self, dtype=dtype_name(dtype))
 
     def copy(self):
         return apply_op("copy", self)

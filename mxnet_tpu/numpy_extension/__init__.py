@@ -226,6 +226,13 @@ def sequence_last(data, sequence_length=None, use_sequence_length=False,
                axis=axis)
 
 
+def flash_attention(query, key, value, causal=False, scale=None):
+    """Fused online-softmax attention over (B, H, T, D) operands (Pallas on
+    TPU). TPU-native extension; see ops/pallas_kernels.py."""
+    return _op("flash_attention", _nd(query), _nd(key), _nd(value),
+               causal=causal, scale=scale)
+
+
 def multihead_attention(query, key, value, mask=None, num_heads=1,
                         dropout=0.0, causal=False, scale=None):
     args = [_nd(query), _nd(key), _nd(value)]

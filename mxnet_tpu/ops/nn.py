@@ -62,6 +62,9 @@ def _convolution(kernel=(), stride=(), dilate=(), pad=(), num_filter=0,
         strides = tuple(stride) if stride else (1,) * nsp
         dil = tuple(dilate) if dilate else (1,) * nsp
         pads = tuple(pad) if pad else (0,) * nsp
+        # no preferred_element_type: the MXU accumulates bf16 convs in f32
+        # internally, and a widened output dtype breaks the conv transpose
+        # rule under grad
         y = lax.conv_general_dilated(
             x, w,
             window_strides=strides,
@@ -69,11 +72,7 @@ def _convolution(kernel=(), stride=(), dilate=(), pad=(), num_filter=0,
             rhs_dilation=dil,
             dimension_numbers=(lhs_l, rhs_l, out_l),
             feature_group_count=num_group,
-            preferred_element_type=jnp.float32
-            if x.dtype == jnp.bfloat16 else None,
         )
-        if y.dtype != x.dtype:
-            y = y.astype(x.dtype)
         if not no_bias:
             c_axis = out_l.index("C")
             bshape = [1] * nd
@@ -216,11 +215,16 @@ def _batch_norm(eps=1e-5, momentum=0.9, fix_gamma=True, use_batch_stats=True,
 @register("layer_norm")
 def _layer_norm(axis=-1, eps=1e-5):
     def f(x, gamma, beta):
+        ax = axis if axis >= 0 else x.ndim + axis
+        if ax == x.ndim - 1:
+            # fused row-norm kernel on TPU (Pallas), XLA formula elsewhere
+            from .pallas_kernels import fused_layer_norm
+
+            return fused_layer_norm(x, gamma, beta, eps)
         mean = jnp.mean(x, axis=axis, keepdims=True)
         var = jnp.var(x, axis=axis, keepdims=True)
         inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
         shape = [1] * x.ndim
-        ax = axis if axis >= 0 else x.ndim + axis
         shape[ax] = x.shape[ax]
         return (x - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
 
@@ -480,7 +484,9 @@ def _ctc_loss(use_data_lengths=False, use_label_lengths=False, blank_label="firs
     return f
 
 
-# attention projections — reference: src/operator/contrib/transformer.cc
+# attention — reference: src/operator/contrib/transformer.cc. The unmasked
+# path routes through the Pallas flash-attention kernel (online softmax,
+# no O(T^2) materialization); arbitrary masks use the XLA path.
 @register("multihead_attention")
 def _multihead_attention(num_heads=1, dropout=0.0, causal=False, scale=None):
     def f(q, k, v, *mask):
@@ -492,14 +498,30 @@ def _multihead_attention(num_heads=1, dropout=0.0, causal=False, scale=None):
         kh = k.reshape(B, Tk, num_heads, D).transpose(0, 2, 1, 3)
         vh = v.reshape(B, Tk, num_heads, D).transpose(0, 2, 1, 3)
         s = scale if scale is not None else 1.0 / (D ** 0.5)
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
-        if causal:
-            cm = jnp.tril(jnp.ones((Tq, Tk), bool))
-            logits = jnp.where(cm, logits, -jnp.inf)
-        if mask:
-            logits = jnp.where(mask[0].astype(bool), logits, -jnp.inf)
-        w = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
+        if not mask:
+            from .pallas_kernels import flash_attention
+
+            out = flash_attention(qh, kh, vh, s, causal)
+        else:
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+            if causal:
+                # bottom-right aligned (decode with cached KV: Tk >= Tq)
+                cm = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+                logits = jnp.where(cm, logits, -1e30)
+            logits = jnp.where(mask[0].astype(bool), logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", w, vh)
         return out.transpose(0, 2, 1, 3).reshape(B, Tq, E)
+
+    return f
+
+
+@register("flash_attention")
+def _flash_attention_op(num_heads=1, causal=False, scale=None):
+    def f(q, k, v):
+        # (B, H, T, D) layout
+        from .pallas_kernels import flash_attention
+
+        return flash_attention(q, k, v, scale, causal)
 
     return f

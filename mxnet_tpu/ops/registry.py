@@ -65,11 +65,21 @@ class Op:
         self.nout = nout
 
     def fn(self, **attrs):
-        """Pure function for this op specialized on static attrs (cached)."""
+        """Pure function for this op specialized on static attrs (cached).
+
+        The synthetic ``__amp__`` attr (set by invoke when mixed precision is
+        active) wraps the fn with input casts INSIDE the pure function, so
+        deferred-compute graphs replay the cast under jit (reference analog:
+        amp cast nodes inserted by low_precision_pass.cc).
+        """
         key = _freeze(attrs)
         f = self._fn_cache.get(key)
         if f is None:
+            attrs = dict(attrs)
+            amp_dt = attrs.pop("__amp__", None)
             f = self._make_fn(**attrs)
+            if amp_dt is not None:
+                f = _amp_wrap(f, amp_dt)
             self._fn_cache[key] = f
         return f
 
@@ -127,6 +137,11 @@ def invoke(op: Op, inputs, attrs=None, out=None):
     from .. import _deferred_compute as dc
 
     attrs = attrs or {}
+    from .. import amp as _amp
+
+    if _amp.is_enabled() and op.name in _amp.MXU_OPS and \
+            "__amp__" not in attrs:
+        attrs = {**attrs, "__amp__": _amp.target_dtype()}
     fn = op.fn(**attrs)
 
     arg_list = list(inputs)
@@ -137,11 +152,6 @@ def invoke(op: Op, inputs, attrs=None, out=None):
         # tracing it becomes a fresh-per-call input, see _deferred_compute)
         arg_list = [_rnd._next_key()] + arg_list
     datas = [x._data if isinstance(x, NDArray) else x for x in arg_list]
-
-    from .. import amp as _amp
-
-    if _amp.is_enabled():
-        datas = _amp.maybe_cast_inputs(op.name, datas)
 
     node = None
     if ag.is_recording() and any(
@@ -200,11 +210,29 @@ def _write_out(out, outputs, multi):
         out._ag_info = outputs[0]._ag_info
 
 
+def _amp_wrap(f, dtype_name):
+    import jax.numpy as jnp
+
+    tgt = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float16
+
+    def wrapped(*args):
+        cast = [a.astype(tgt)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in args]
+        return f(*cast)
+
+    return wrapped
+
+
 def _is_float(dtype) -> bool:
     try:
-        return onp.issubdtype(onp.dtype(dtype), onp.floating)
+        d = onp.dtype(dtype)
     except TypeError:
         return str(dtype) in ("bfloat16", "float8_e4m3fn", "float8_e5m2")
+    if onp.issubdtype(d, onp.floating):
+        return True
+    # ml_dtypes extension floats (bfloat16/fp8) are not np.floating subtypes
+    return d.name in ("bfloat16", "float8_e4m3fn", "float8_e5m2")
 
 
 def apply_op(name: str, *inputs, **attrs):

@@ -16,8 +16,10 @@ from .mesh import (make_mesh, default_mesh, replicated, shard_batch,
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           axis_index, axis_size)
 from .learner import Learner, to_optax
+from .ring_attention import ring_attention, ring_attention_sharded
 
 __all__ = ["make_mesh", "default_mesh", "replicated", "shard_batch",
            "shard_params", "AxisNames", "all_reduce", "all_gather",
            "reduce_scatter", "ppermute", "axis_index", "axis_size",
-           "Learner", "to_optax"]
+           "Learner", "to_optax", "ring_attention",
+           "ring_attention_sharded"]

@@ -80,23 +80,29 @@ class Learner:
         self.loss_fn = loss_fn
         self.mesh = mesh if mesh is not None else default_mesh()
         self.tx = to_optax(optimizer)
-
-        params = net.collect_params()
-        self._param_names = [name for name, p in params.items()
-                             if p.grad_req != "null"]
-        self._params = {name: params[name] for name in self._param_names}
-        for name, p in self._params.items():
-            if p._data is None:
-                raise MXNetError(f"parameter {name} is not initialized")
-
+        self._param_spec_fn = param_spec_fn
         self._shard_in = shard_batch(self.mesh)
-        pf = shard_params(self.mesh, param_spec_fn)
-        self._param_shardings = [pf(n, self._params[n].data())
-                                 for n in self._param_names]
         self._repl = replicated(self.mesh)
+        self._params = None  # collected lazily (deferred shapes need a fwd)
         self._step_fn = None
         self._opt_state = None
         self._traced_for = None
+
+    def _collect(self):
+        from .mesh import shard_params
+
+        params = self.net.collect_params()
+        for name, p in params.items():
+            if p.grad_req != "null" and p._data is None:
+                raise MXNetError(
+                    f"parameter {name} is still uninitialized after the "
+                    "settle forward — initialize it or set grad_req='null'")
+        self._param_names = [name for name, p in params.items()
+                             if p.grad_req != "null"]
+        self._params = {name: params[name] for name in self._param_names}
+        pf = shard_params(self.mesh, self._param_spec_fn)
+        self._param_shardings = [pf(n, self._params[n].data())
+                                 for n in self._param_names]
 
     # -- tracing ------------------------------------------------------------
     def _build(self, x, y):
@@ -105,6 +111,11 @@ class Learner:
         from ..cached_op import build_executor
 
         with ag.train_mode():  # BN batch stats + dropout active in the trace
+            if any(p._data is None
+                   for p in self.net.collect_params().values()):
+                with ag.pause():  # predict mode: no BN stat side effects
+                    self.net(x)  # settle deferred-shape parameter init
+            self._collect()
             with dc.context() as tctx:
                 data_vars = [dc.set_variable(x, "data0"),
                              dc.set_variable(y, "label0")]

@@ -1,0 +1,195 @@
+"""Flash attention / ring attention / BERT / LSTM-LM (north-star configs
+3-4; SP is a first-class TPU-native capability — SURVEY §5.7)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd, gluon, parallel
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _ref_attention(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = onp.einsum("bhqd,bhkd->bhqk", q, k) / onp.sqrt(d)
+    if causal:
+        t = s.shape[-1]
+        mask = onp.tril(onp.ones((t, t), bool))
+        s = onp.where(mask, s, -1e30)
+    p = onp.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return onp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def test_flash_attention_matches_reference():
+    q = onp.random.randn(2, 3, 16, 8).astype("float32")
+    k = onp.random.randn(2, 3, 16, 8).astype("float32")
+    v = onp.random.randn(2, 3, 16, 8).astype("float32")
+    out = npx.flash_attention(np.array(q), np.array(k), np.array(v))
+    assert_almost_equal(out, _ref_attention(q, k, v), rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_causal():
+    q = onp.random.randn(1, 2, 8, 4).astype("float32")
+    out = npx.flash_attention(np.array(q), np.array(q), np.array(q),
+                              causal=True)
+    assert_almost_equal(out, _ref_attention(q, q, q, causal=True),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_flash_attention_grad():
+    q = np.array(onp.random.randn(1, 2, 8, 4).astype("float32"))
+    k = np.array(onp.random.randn(1, 2, 8, 4).astype("float32"))
+    v = np.array(onp.random.randn(1, 2, 8, 4).astype("float32"))
+    for x in (q, k, v):
+        x.attach_grad()
+    with autograd.record():
+        loss = npx.flash_attention(q, k, v).sum()
+    loss.backward()
+    assert float(abs(q.grad).sum()) > 0
+    assert float(abs(k.grad).sum()) > 0
+    assert float(abs(v.grad).sum()) > 0
+
+
+def test_multihead_attention_uses_same_math():
+    B, T, H, D = 2, 8, 2, 4
+    q = onp.random.randn(B, T, H * D).astype("float32")
+    got = npx.multihead_attention(np.array(q), np.array(q), np.array(q),
+                                  num_heads=H)
+    qh = q.reshape(B, T, H, D).transpose(0, 2, 1, 3)
+    ref = _ref_attention(qh, qh, qh).transpose(0, 2, 1, 3).reshape(B, T,
+                                                                   H * D)
+    assert_almost_equal(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_matches_flash():
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = parallel.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, H, T, D = 1, 2, 32, 8
+    q = onp.random.randn(B, H, T, D).astype("float32")
+    k = onp.random.randn(B, H, T, D).astype("float32")
+    v = onp.random.randn(B, H, T, D).astype("float32")
+    out = parallel.ring_attention_sharded(np.array(q), np.array(k),
+                                          np.array(v), mesh)
+    assert_almost_equal(out, _ref_attention(q, k, v), rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_causal():
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = parallel.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    B, H, T, D = 1, 1, 16, 4
+    q = onp.random.randn(B, H, T, D).astype("float32")
+    out = parallel.ring_attention_sharded(np.array(q), np.array(q),
+                                          np.array(q), mesh, causal=True)
+    assert_almost_equal(out, _ref_attention(q, q, q, causal=True),
+                        rtol=1e-4, atol=1e-4)
+
+
+def test_fused_layer_norm_path():
+    from mxnet_tpu.ops.pallas_kernels import fused_layer_norm
+    import jax.numpy as jnp
+
+    x = onp.random.randn(4, 256).astype("float32")
+    g = onp.ones(256, "float32")
+    b = onp.zeros(256, "float32")
+    out = fused_layer_norm(jnp.asarray(x), jnp.asarray(g), jnp.asarray(b))
+    ref = (x - x.mean(-1, keepdims=True)) / onp.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(onp.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------- BERT
+def _tiny_bert(**kw):
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+
+    return BERTModel(vocab_size=100, num_layers=2, units=32, hidden_size=64,
+                     num_heads=4, max_length=32, **kw)
+
+
+def test_bert_forward_shapes():
+    bert = _tiny_bert()
+    bert.initialize()
+    tokens = np.array(onp.random.randint(0, 100, (2, 16)))
+    seq, pooled = bert(tokens)
+    assert seq.shape == (2, 16, 32)
+    assert pooled.shape == (2, 32)
+    # with segments + valid_length
+    segs = np.zeros((2, 16)).astype("int32")
+    vl = np.array([16, 8])
+    seq, pooled = bert(tokens, segs, vl)
+    assert seq.shape == (2, 16, 32)
+
+
+def test_bert_pretraining_step():
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForPretraining
+
+    bert = _tiny_bert()
+    model = BERTForPretraining(bert, vocab_size=100)
+    model.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    tokens = np.array(onp.random.randint(0, 100, (2, 16)))
+    labels = np.array(onp.random.randint(0, 100, (2, 16)))
+    nsp_labels = np.array([0, 1])
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            mlm, nsp = model(tokens)
+            loss = loss_fn(mlm, labels).mean() + \
+                loss_fn(nsp, nsp_labels).mean()
+        loss.backward()
+        trainer.step(2)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+
+
+def test_bert_hybridize_consistency():
+    bert = _tiny_bert(dropout=0.0)
+    bert.initialize()
+    tokens = np.array(onp.random.randint(0, 100, (2, 16)))
+    seq1, _ = bert(tokens)
+    bert.hybridize()
+    seq2, _ = bert(tokens)
+    assert_almost_equal(seq1, seq2, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------- LSTM LM
+def test_rnn_lm_training():
+    from mxnet_tpu.gluon.model_zoo.rnn_lm import RNNModel
+
+    model = RNNModel(vocab_size=50, embed_size=16, hidden_size=16,
+                     num_layers=2, dropout=0.0, tie_weights=True)
+    model.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    data = np.array(onp.random.randint(0, 50, (4, 12)))
+    target = np.array(onp.random.randint(0, 50, (4, 12)))
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            logits = model(data)
+            loss = loss_fn(logits, target).mean()
+        loss.backward()
+        trainer.step(4)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_rnn_lm_stateful():
+    from mxnet_tpu.gluon.model_zoo.rnn_lm import RNNModel
+
+    model = RNNModel(vocab_size=50, embed_size=8, hidden_size=8,
+                     num_layers=1, dropout=0.0)
+    model.initialize()
+    data = np.array(onp.random.randint(0, 50, (2, 6)))
+    states = model.begin_state(2)
+    logits, states = model(data, states)
+    assert logits.shape == (2, 6, 50)
+    assert states[0].shape == (1, 2, 8)

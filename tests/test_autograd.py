@@ -200,3 +200,14 @@ def test_exception_at_sync():
         b = np.ones((4, 5))
         c = a @ b  # shape mismatch
         c.wait_to_read()
+
+
+def test_bf16_outputs_join_tape():
+    # regression: ml_dtypes bfloat16 is not a np.floating subtype; bf16 op
+    # outputs must still carry autograd info (amp + eager training)
+    x = np.array([1.0, 2.0]).astype("bfloat16")
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert float(abs(x.grad).sum()) > 0
