@@ -45,6 +45,16 @@ from .kvstore import KVStore
 from . import gluon
 from . import nd
 from . import metric
+from . import io
+from . import image
+from . import recordio
+from . import operator
+from . import library
+from . import subgraph
+from . import visualization
+from . import callback
+from . import model
+from .ndarray import sparse
 from . import profiler
 from . import runtime
 from . import util

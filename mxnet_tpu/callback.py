@@ -1,0 +1,79 @@
+"""Training callbacks (reference: python/mxnet/callback.py — Speedometer,
+do_checkpoint, log_train_metric)."""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
+           "ProgressBar"]
+
+
+class Speedometer:
+    """Log samples/sec every N batches (reference: callback.py Speedometer)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                msg = f"Epoch[{param.epoch}] Batch [{count}]\t" \
+                      f"Speed: {speed:.2f} samples/sec"
+                if param.eval_metric is not None:
+                    for name, value in param.eval_metric.get_name_value():
+                        msg += f"\t{name}={value:.6f}"
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                logging.getLogger("mxnet_tpu").info(msg)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving checkpoints (reference: do_checkpoint)."""
+    from . import model
+
+    def _callback(epoch, sym, net_or_params, trainer=None):
+        if (epoch + 1) % period == 0:
+            model.save_checkpoint(prefix, epoch + 1, sym, net_or_params)
+
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                logging.getLogger("mxnet_tpu").info(
+                    "Iter[%d] Batch[%d] Train-%s=%f", param.epoch,
+                    param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class ProgressBar:
+    def __init__(self, total, length=40):
+        self.total = total
+        self.length = length
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.length * count / float(self.total)))
+        bar = "#" * filled + "-" * (self.length - filled)
+        print(f"\r[{bar}] {100.0 * count / self.total:.1f}%", end="")

@@ -8,6 +8,13 @@ from .resnet import __all__ as _resnet_all
 from .alexnet import alexnet, AlexNet
 from .vgg import vgg11, vgg13, vgg16, vgg19, VGG
 from .mlp import mlp, MLP
+from .densenet import (densenet121, densenet161, densenet169, densenet201,
+                       DenseNet)
+from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,
+                        mobilenet0_25, mobilenet_v2_1_0, mobilenet_v2_0_5,
+                        MobileNet, MobileNetV2)
+from .squeezenet import squeezenet1_0, squeezenet1_1, SqueezeNet
+from .inception import inception_v3, Inception3
 
 _models = {}
 
@@ -18,8 +25,18 @@ def _register_models():
     for name in _resnet_all:
         if name.startswith("resnet") and name[6].isdigit():
             _models[name] = getattr(_r, name)
-    _models.update({"alexnet": alexnet, "vgg11": vgg11, "vgg13": vgg13,
-                    "vgg16": vgg16, "vgg19": vgg19, "mlp": mlp})
+    _models.update({
+        "alexnet": alexnet, "vgg11": vgg11, "vgg13": vgg13,
+        "vgg16": vgg16, "vgg19": vgg19, "mlp": mlp,
+        "densenet121": densenet121, "densenet161": densenet161,
+        "densenet169": densenet169, "densenet201": densenet201,
+        "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+        "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+        "mobilenetv2_1.0": mobilenet_v2_1_0,
+        "mobilenetv2_0.5": mobilenet_v2_0_5,
+        "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+        "inceptionv3": inception_v3,
+    })
 
 
 _register_models()

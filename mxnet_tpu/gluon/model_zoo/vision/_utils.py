@@ -1,0 +1,11 @@
+"""Shared zoo helpers."""
+from ....base import MXNetError
+
+
+def check_pretrained(kwargs):
+    """pretrained=True must fail loudly: this is a zero-egress build
+    (reference precedent: resnet.py get_resnet)."""
+    if kwargs.pop("pretrained", False):
+        raise MXNetError("no pretrained weights in the zero-egress build; "
+                         "load_parameters() from a local file instead")
+    return kwargs

@@ -4,6 +4,8 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ... import nn
 
+from ._utils import check_pretrained
+
 __all__ = ["AlexNet", "alexnet"]
 
 
@@ -31,5 +33,5 @@ class AlexNet(HybridBlock):
 
 
 def alexnet(**kwargs):
-    kwargs.pop("pretrained", None)
+    check_pretrained(kwargs)
     return AlexNet(**kwargs)

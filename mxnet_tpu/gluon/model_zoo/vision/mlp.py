@@ -5,6 +5,8 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ... import nn
 
+from ._utils import check_pretrained
+
 __all__ = ["MLP", "mlp"]
 
 
@@ -22,5 +24,5 @@ class MLP(HybridBlock):
 
 
 def mlp(**kwargs):
-    kwargs.pop("pretrained", None)
+    check_pretrained(kwargs)
     return MLP(**kwargs)

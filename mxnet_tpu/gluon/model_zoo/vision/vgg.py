@@ -4,6 +4,8 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ... import nn
 
+from ._utils import check_pretrained
+
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
 
 vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
@@ -37,7 +39,7 @@ class VGG(HybridBlock):
 
 
 def _vgg(num_layers, **kwargs):
-    kwargs.pop("pretrained", None)
+    check_pretrained(kwargs)
     layers, filters = vgg_spec[num_layers]
     return VGG(layers, filters, **kwargs)
 

@@ -1,0 +1,304 @@
+"""mx.image — image IO + augmentation (reference: python/mxnet/image/image.py
+1.6k LoC of OpenCV-backed augmenters + ImageIter).
+
+Host-side numpy/PIL implementations (the OpenCV role); batches transfer to
+TPU once per batch. Augmenter objects mirror the reference API
+(CreateAugmenter, ImageIter) so legacy scripts run.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import io as _io
+from .. import recordio as _recordio
+from ..gluon.data.vision.transforms import _resize_np
+from .. import random as _random
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "center_crop", "color_normalize", "HorizontalFlipAug",
+           "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "CreateAugmenter",
+           "ImageIter"]
+
+
+def _np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else onp.asarray(img)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    from PIL import Image
+
+    img = Image.open(filename)
+    if flag:
+        img = img.convert("RGB")
+    return NDArray(onp.asarray(img))
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    import io as _pyio
+
+    from PIL import Image
+
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = Image.open(_pyio.BytesIO(bytes(buf)))
+    if flag:
+        img = img.convert("RGB")
+    return NDArray(onp.asarray(img))
+
+
+def imresize(src, w, h, interp=1):
+    return NDArray(_resize_np(_np(src), (w, h)))
+
+
+def resize_short(src, size, interp=1):
+    img = _np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        nw, nh = size, int(h * size / w)
+    else:
+        nw, nh = int(w * size / h), size
+    return NDArray(_resize_np(img, (nw, nh)))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    img = _np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (size[0] != w or size[1] != h):
+        img = _resize_np(img, size)
+    return NDArray(img)
+
+
+def random_crop(src, size, interp=1):
+    img = _np(src)
+    h, w = img.shape[:2]
+    cw, ch = size
+    x0 = _random.host_rng.randint(0, max(1, w - cw + 1))
+    y0 = _random.host_rng.randint(0, max(1, h - ch + 1))
+    return fixed_crop(src, x0, y0, cw, ch), (x0, y0, cw, ch)
+
+
+def center_crop(src, size, interp=1):
+    img = _np(src)
+    h, w = img.shape[:2]
+    cw, ch = size
+    x0 = max(0, (w - cw) // 2)
+    y0 = max(0, (h - ch) // 2)
+    return fixed_crop(src, x0, y0, cw, ch), (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None):
+    img = _np(src).astype("float32")
+    img = img - _np(mean)
+    if std is not None:
+        img = img / _np(std)
+    return NDArray(img)
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return resize_short(src, self.size)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1])
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return random_crop(src, self.size)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+
+    def __call__(self, src):
+        return center_crop(src, self.size)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.host_rng.rand() < self.p:
+            return NDArray(_np(src)[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return NDArray(_np(src).astype(self.typ))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class _JitterAug(Augmenter):
+    def __init__(self, jitter):
+        super().__init__(jitter=jitter)
+        self.jitter = jitter
+
+    def _alpha(self):
+        return 1.0 + _random.host_rng.uniform(-self.jitter, self.jitter)
+
+
+class BrightnessJitterAug(_JitterAug):
+    def __call__(self, src):
+        return NDArray(_np(src).astype("float32") * self._alpha())
+
+
+class ContrastJitterAug(_JitterAug):
+    def __call__(self, src):
+        img = _np(src).astype("float32")
+        gray = img.mean()
+        a = self._alpha()
+        return NDArray(img * a + gray * (1 - a))
+
+
+class SaturationJitterAug(_JitterAug):
+    def __call__(self, src):
+        img = _np(src).astype("float32")
+        gray = img.mean(axis=-1, keepdims=True)
+        a = self._alpha()
+        return NDArray(img * a + gray * (1 - a))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Build the standard augmenter list (reference: image.py CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std if std is not None
+                                         else onp.ones(3)))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Image iterator over .rec or .lst+images (reference: image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
+                                           if k in ("resize", "rand_crop",
+                                                    "rand_mirror", "mean",
+                                                    "std")})
+        self._records = []
+        if path_imgrec:
+            rec = _recordio.MXRecordIO(path_imgrec, "r")
+            while True:
+                item = rec.read()
+                if item is None:
+                    break
+                self._records.append(item)
+            rec.close()
+        elif path_imglist:
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    label = float(parts[1])
+                    self._records.append((label, path_root + parts[-1]))
+        else:
+            raise MXNetError("need path_imgrec or path_imglist")
+        self._from_rec = path_imgrec is not None
+        self._shuffle = shuffle
+        self._order = onp.arange(len(self._records))
+        self.reset()
+
+    def reset(self):
+        self.cur = 0
+        if self._shuffle:
+            onp.random.shuffle(self._order)
+
+    def _load(self, idx):
+        if self._from_rec:
+            header, img = _recordio.unpack_img(self._records[idx])
+            label = header.label
+        else:
+            label, path = self._records[idx]
+            img = _np(imread(path))
+        for aug in self.auglist:
+            img = aug(img)
+        arr = _np(img).astype("float32")
+        if arr.ndim == 3 and arr.shape[2] in (1, 3):
+            arr = arr.transpose(2, 0, 1)
+        return arr, label
+
+    def __next__(self):
+        if self.cur >= len(self._records):
+            raise StopIteration
+        n = min(self.batch_size, len(self._records) - self.cur)
+        imgs = onp.zeros((self.batch_size,) + self.data_shape, "float32")
+        labels = onp.zeros((self.batch_size,), "float32")
+        for i in range(n):
+            arr, label = self._load(self._order[self.cur + i])
+            imgs[i] = arr
+            labels[i] = label if onp.isscalar(label) else label[0] \
+                if hasattr(label, "__len__") else float(label)
+        self.cur += n
+        return _io.DataBatch([NDArray(imgs)], [NDArray(labels)],
+                             pad=self.batch_size - n)

@@ -1,0 +1,403 @@
+"""mx.io — iterator-style data pipeline (reference: python/mxnet/io/io.py:
+DataIter:179, NDArrayIter:490, MXDataIter:799 over src/io/ N15).
+
+ImageRecordIter is backed by the native C++ RecordIO engine
+(src/io_native/recordio.cc): indexed reads + a double-buffered prefetch
+thread deliver packed record batches; JPEG decode + augmentation run in
+Python threads (PIL releases the GIL); one device transfer per batch.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import recordio as _recordio
+
+__all__ = ["DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ImageRecordIter", "ResizeIter", "PrefetchingIter"]
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data if isinstance(data, list) else [data]
+        self.label = (label if isinstance(label, list) else
+                      [label] if label is not None else [])
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __repr__(self):
+        shapes = [d.shape for d in self.data]
+        return f"DataBatch: data shapes: {shapes} pad: {self.pad}"
+
+
+class DataIter:
+    """Iterator base (reference: io.py DataIter:179)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        """Legacy-style accessor ('while True: batch = it.next()')."""
+        if type(self).__next__ is not DataIter.__next__:
+            return self.__next__()
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.py NDArrayIter:490)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = self._init_data(data, data_name)
+        self.label = self._init_data(label, label_name) if label is not None \
+            else []
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._order = onp.arange(self.num_data)
+        if shuffle:
+            onp.random.shuffle(self._order)
+
+    @staticmethod
+    def _init_data(data, default_name):
+        if data is None:
+            return []
+        if isinstance(data, (onp.ndarray, NDArray)):
+            data = [(default_name, data)]
+        elif isinstance(data, dict):
+            data = list(data.items())
+        elif isinstance(data, (list, tuple)):
+            data = [(f"{default_name}_{i}" if i else default_name, d)
+                    for i, d in enumerate(data)]
+        out = []
+        for name, d in data:
+            arr = d.asnumpy() if isinstance(d, NDArray) else onp.asarray(d)
+            out.append((name, arr))
+        return out
+
+    @property
+    def provide_data(self):
+        return [(name, (self.batch_size,) + d.shape[1:])
+                for name, d in self.data]
+
+    @property
+    def provide_label(self):
+        return [(name, (self.batch_size,) + d.shape[1:])
+                for name, d in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            onp.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for _, arr in arrays:
+            idx = self._order[self.cursor:self.cursor + self.batch_size]
+            chunk = arr[idx]
+            if len(chunk) < self.batch_size and \
+                    self.last_batch_handle == "pad":
+                need = self.batch_size - len(chunk)
+                chunk = onp.concatenate([chunk, arr[self._order[:need]]])
+            out.append(NDArray(chunk))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference: src/io iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        data = onp.loadtxt(data_csv, delimiter=",", dtype="float32")
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype="float32")
+        self._inner = NDArrayIter(data, label, batch_size, **kwargs)
+        super().__init__(batch_size)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def __next__(self):
+        return next(self._inner)
+
+    def reset(self):
+        self._inner.reset()
+
+
+class ImageRecordIter(DataIter):
+    """Batched image pipeline over .rec files (reference:
+    src/io/iter_image_recordio_2.cc:887 + python MXDataIter facade).
+
+    Native C++ prefetch thread streams packed record batches; decode and
+    augmentation happen in python worker threads.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, resize=-1, round_batch=True, seed=0, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._resize = resize
+        self._mean = onp.array([mean_r, mean_g, mean_b],
+                               dtype="float32").reshape(3, 1, 1)
+        self._std = onp.array([std_r, std_g, std_b],
+                              dtype="float32").reshape(3, 1, 1)
+        self._rng = onp.random.RandomState(seed)
+        from ._native import get_lib
+
+        self._lib = get_lib()
+        self._path = path_imgrec
+        if self._lib is None:
+            raise MXNetError("native recordio engine unavailable "
+                             "(g++ missing?)")
+        self._reader = self._lib.rio_reader_open(path_imgrec.encode())
+        if not self._reader:
+            raise MXNetError(f"cannot open record file {path_imgrec}")
+        self._count = self._lib.rio_reader_count(self._reader)
+        self._prefetch = None
+        import concurrent.futures
+
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=int(kwargs.get("preprocess_threads", 4)))
+        self.reset()
+
+    @property
+    def num_records(self):
+        return self._count
+
+    def reset(self):
+        if self._prefetch:
+            self._lib.rio_prefetch_free(self._prefetch)
+        order = onp.arange(self._count, dtype=onp.uint64)
+        if self._shuffle:
+            self._rng.shuffle(order)
+        arr = (ctypes.c_uint64 * len(order))(*order.tolist())
+        self._prefetch = self._lib.rio_prefetch_create(
+            self._reader, arr, len(order), self.batch_size)
+
+    def _decode_one(self, payload):
+        header, img = _recordio.unpack_img(payload)
+        c, h, w = self.data_shape
+        if self._resize > 0:
+            # resize the SHORTER edge, preserving aspect (reference
+            # semantics: image_aug_default.cc resize)
+            from ..gluon.data.vision.transforms import _resize_np
+
+            ih0, iw0 = img.shape[0], img.shape[1]
+            if ih0 < iw0:
+                img = _resize_np(img, (int(iw0 * self._resize / ih0),
+                                       self._resize))
+            else:
+                img = _resize_np(img, (self._resize,
+                                       int(ih0 * self._resize / iw0)))
+        ih, iw = img.shape[0], img.shape[1]
+        if ih < h or iw < w:
+            from ..gluon.data.vision.transforms import _resize_np
+
+            img = _resize_np(img, (max(w, iw), max(h, ih)))
+            ih, iw = img.shape[0], img.shape[1]
+        if self._rand_crop and (ih > h or iw > w):
+            y0 = self._rng.randint(0, ih - h + 1)
+            x0 = self._rng.randint(0, iw - w + 1)
+        else:
+            y0, x0 = (ih - h) // 2, (iw - w) // 2
+        img = img[y0:y0 + h, x0:x0 + w]
+        if self._rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = img.astype("float32").transpose(2, 0, 1)
+        chw = (chw - self._mean) / self._std
+        label = header.label if header.flag else float(header.label)
+        return chw, label
+
+    def __next__(self):
+        data_p = ctypes.c_char_p()
+        off_p = ctypes.POINTER(ctypes.c_uint64)()
+        nbytes = ctypes.c_uint64()
+        n = self._lib.rio_prefetch_next(self._prefetch,
+                                        ctypes.byref(data_p),
+                                        ctypes.byref(off_p),
+                                        ctypes.byref(nbytes))
+        if n <= 0:
+            raise StopIteration
+        blob = ctypes.string_at(data_p, nbytes.value)
+        offsets = [off_p[i] for i in range(n + 1)]
+        self._lib.rio_prefetch_release(self._prefetch)
+        imgs = onp.empty((self.batch_size,) + self.data_shape, "float32")
+        labels = onp.zeros((self.batch_size, self.label_width), "float32")
+        # decode/augment in a thread pool (PIL/numpy release the GIL)
+        results = list(self._pool.map(
+            self._decode_one,
+            [blob[offsets[i]:offsets[i + 1]] for i in range(n)]))
+        for i, (chw, label) in enumerate(results):
+            imgs[i] = chw
+            labels[i] = label
+        pad = self.batch_size - n
+        if pad:
+            imgs[n:] = imgs[:1]
+            labels[n:] = labels[:1]
+        lab = labels[:, 0] if self.label_width == 1 else labels
+        return DataBatch([NDArray(imgs)], [NDArray(lab)], pad=pad)
+
+    def __del__(self):
+        try:
+            if self._prefetch:
+                self._lib.rio_prefetch_free(self._prefetch)
+            if self._reader:
+                self._lib.rio_reader_free(self._reader)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class ResizeIter(DataIter):
+    """Stretch/limit another iterator to a fixed number of batches."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def __next__(self):
+        if self.cur >= self.size:
+            raise StopIteration
+        self.cur += 1
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter.reset()
+            return next(self.data_iter)
+
+
+class PrefetchingIter(DataIter):
+    """Thread that stays one batch ahead (reference: iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if isinstance(iters, list):
+            if len(iters) != 1:
+                raise MXNetError("multi-iterator prefetching is not "
+                                 "supported; pass one iterator")
+            iters = iters[0]
+        if rename_data is not None or rename_label is not None:
+            raise MXNetError("rename_data/rename_label are not supported")
+        super().__init__(iters.batch_size)
+        self._iter = iters
+        self._queue: list = []
+        self._cv = threading.Condition()
+        self._done = False
+        self._thread = None
+        self._start_worker()
+
+    def _start_worker(self):
+        self._queue = []
+        self._done = False
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        try:
+            for batch in self._iter:
+                with self._cv:
+                    while len(self._queue) >= 2 and not self._done:
+                        self._cv.wait(0.1)
+                    if self._done:
+                        return
+                    self._queue.append(batch)
+                    self._cv.notify_all()
+        finally:
+            with self._cv:
+                self._queue.append(None)
+                self._cv.notify_all()
+
+    def reset(self):
+        """Stop the worker, reset the wrapped iterator, start a new epoch."""
+        with self._cv:
+            self._done = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._iter.reset()
+        self._start_worker()
+
+    def __next__(self):
+        with self._cv:
+            while not self._queue:
+                self._cv.wait()
+            batch = self._queue.pop(0)
+            self._cv.notify_all()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def __del__(self):
+        self._done = True

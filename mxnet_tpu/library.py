@@ -1,0 +1,49 @@
+"""Extension library loading.
+
+Reference: python/mxnet/library.py + the versioned C ABI
+(include/mxnet/lib_api.h, MXLoadLib c_api.cc:1522) for out-of-tree custom
+ops / graph passes / subgraph properties. TPU-native extension model: an
+extension is a PYTHON module (optionally backed by its own native code or
+Pallas kernels) that registers ops via mxnet_tpu.ops.register, custom ops via
+mxnet_tpu.operator.register, optimizers/initializers via their registries, or
+graph passes via mxnet_tpu.subgraph. ``load()`` imports the module from a
+file path and invokes its ``register_ops(registry)`` hook if present.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_libraries"]
+
+_loaded: dict[str, object] = {}
+
+
+def load(path, verbose=True):
+    """Load an extension module from a .py file (reference: mx.library.load).
+
+    The module may define ``register_ops()`` which is called after import.
+    """
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        raise MXNetError(f"extension {path} not found")
+    if path in _loaded:
+        return _loaded[path]
+    name = "mxnet_tpu_ext_" + os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise MXNetError(f"cannot import extension {path}")
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    if hasattr(module, "register_ops"):
+        module.register_ops()
+    _loaded[path] = module
+    return module
+
+
+def loaded_libraries():
+    return list(_loaded)

@@ -1,0 +1,185 @@
+"""Sparse NDArrays: CSR and RowSparse.
+
+Reference: python/mxnet/ndarray/sparse.py (CSRNDArray:301,
+RowSparseNDArray:575) over src/operator/tensor/ sparse kernels. TPU-native
+reality (SURVEY §7 hard parts): XLA has no sparse tensor support, so
+
+- RowSparse — whose reference use case is embedding gradients / sparse
+  optimizer updates — is implemented natively as (indices, values) pairs with
+  gather/scatter lowering: dense conversion is one scatter, retain/update are
+  gathers. These map cleanly onto the MXU-adjacent scatter units.
+- CSR is a host-resident format for data interchange (the reference's main
+  CSR consumer is LibSVM-style input pipelines): matrix-vector products
+  convert through dense on device, documented as such.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
+           "row_sparse_array", "BaseSparseNDArray"]
+
+
+class BaseSparseNDArray:
+    @property
+    def stype(self):
+        raise NotImplementedError
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def astype(self, dtype):
+        raise NotImplementedError
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (reference: sparse.py:301)."""
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = onp.asarray(data)
+        self.indices = onp.asarray(indices, dtype=onp.int64)
+        self.indptr = onp.asarray(indptr, dtype=onp.int64)
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def nnz(self):
+        return len(self.data)
+
+    def todense(self) -> NDArray:
+        out = onp.zeros(self._shape, dtype=self.data.dtype)
+        for row in range(self._shape[0]):
+            lo, hi = self.indptr[row], self.indptr[row + 1]
+            out[row, self.indices[lo:hi]] = self.data[lo:hi]
+        return NDArray(out)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == "csr":
+            return self
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+    def dot(self, other):
+        """SpMV/SpMM via dense on device (no native XLA sparse)."""
+        dense = self.todense()
+        return dense.dot(other)
+
+    def slice(self, start, stop):
+        lo, hi = self.indptr[start], self.indptr[stop]
+        indptr = self.indptr[start:stop + 1] - self.indptr[start]
+        return CSRNDArray(self.data[lo:hi], self.indices[lo:hi], indptr,
+                          (stop - start, self._shape[1]))
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return self.slice(key.start or 0, key.stop or self._shape[0])
+        if isinstance(key, int):
+            return self.slice(key, key + 1).todense().reshape(
+                (self._shape[1],))
+        raise MXNetError("csr supports int/slice indexing only")
+
+    def __repr__(self):
+        return (f"<CSRNDArray {self._shape} nnz={self.nnz} "
+                f"dtype={self.dtype}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor: a subset of rows is stored (reference: sparse.py:575).
+
+    The embedding-gradient format: ``indices`` are the touched row ids,
+    ``data`` their values. Device-friendly: both halves are dense arrays.
+    """
+
+    def __init__(self, data, indices, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(data)
+        self.indices = indices if isinstance(indices, NDArray) else \
+            NDArray(onp.asarray(indices, dtype=onp.int32))
+        self._shape = tuple(shape)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def todense(self) -> NDArray:
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self._shape, self.data._data.dtype)
+        out = out.at[self.indices._data].set(self.data._data)
+        return NDArray(out)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == "row_sparse":
+            return self
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+    def retain(self, row_ids):
+        """Keep only the requested rows (reference: sparse_retain op)."""
+        import jax.numpy as jnp
+
+        rid = row_ids._data if isinstance(row_ids, NDArray) else \
+            jnp.asarray(row_ids)
+        # membership of stored indices in row_ids
+        dense = self.todense()
+        vals = dense._data[rid]
+        return RowSparseNDArray(NDArray(vals), NDArray(rid), self._shape)
+
+    def __repr__(self):
+        return (f"<RowSparseNDArray {self._shape} "
+                f"rows={self.indices.shape[0]} dtype={self.dtype}>")
+
+
+def csr_matrix(arg1, shape=None, dtype="float32"):
+    """Create a CSRNDArray (reference: sparse.py csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(onp.asarray(data, dtype=dtype), indices, indptr,
+                          shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else \
+        onp.asarray(arg1, dtype=dtype)
+    indptr = [0]
+    indices, data = [], []
+    for row in dense:
+        nz = onp.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(onp.asarray(data, dtype=dtype), indices, indptr,
+                      dense.shape)
+
+
+def row_sparse_array(arg1, shape=None, dtype="float32"):
+    """Create a RowSparseNDArray (reference: sparse.py row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(onp.asarray(data, dtype=dtype), indices,
+                                shape)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else \
+        onp.asarray(arg1, dtype=dtype)
+    mask = (dense != 0).any(axis=tuple(range(1, dense.ndim)))
+    idx = onp.nonzero(mask)[0]
+    return RowSparseNDArray(dense[idx], idx, dense.shape)

