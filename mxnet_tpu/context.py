@@ -62,12 +62,16 @@ class Context:
         return plat if plat != "cpu" else "cpu"
 
     def jax_device(self):
-        """Resolve to the concrete ``jax.Device`` (PJRT device)."""
+        """Resolve to the concrete ``jax.Device`` (PJRT device).
+
+        Uses local_devices: under jax.distributed, jax.devices() spans all
+        processes and placing onto another process's device is an error.
+        """
         import jax
 
         plat = self._platform
         try:
-            devs = jax.devices(plat)
+            devs = jax.local_devices(backend=plat)
         except RuntimeError as e:  # platform absent
             if plat != "cpu":
                 raise MXNetError(

@@ -67,7 +67,7 @@ def wait_all() -> None:
     registry-free implementation: a trivial device barrier per device.
     """
     try:
-        for dev in jax.devices():
+        for dev in jax.local_devices():  # only addressable devices
             jax.device_put(0, dev).block_until_ready()
     except Exception as e:  # noqa: BLE001
         raise MXNetError(str(e)) from e

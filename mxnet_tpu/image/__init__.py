@@ -248,14 +248,20 @@ class ImageIter(_io.DataIter):
                                                     "rand_mirror", "mean",
                                                     "std")})
         self._records = []
+        self._rec = None
         if path_imgrec:
-            rec = _recordio.MXRecordIO(path_imgrec, "r")
-            while True:
-                item = rec.read()
-                if item is None:
-                    break
-                self._records.append(item)
-            rec.close()
+            # lazy indexed reads: records stay on disk until a batch needs
+            # them (the native reader builds the in-file index on open)
+            self._rec = _recordio.MXRecordIO(path_imgrec, "r")
+            if self._rec._native:
+                n = self._rec._native.rio_reader_count(self._rec._handle)
+                self._records = list(range(n))
+            else:  # fallback engine: buffer (no random access)
+                while True:
+                    item = self._rec.read()
+                    if item is None:
+                        break
+                    self._records.append(item)
         elif path_imglist:
             with open(path_imglist) as f:
                 for line in f:
@@ -276,7 +282,10 @@ class ImageIter(_io.DataIter):
 
     def _load(self, idx):
         if self._from_rec:
-            header, img = _recordio.unpack_img(self._records[idx])
+            item = self._records[idx]
+            if isinstance(item, int):  # lazy native path
+                item = self._rec._read_at(item)
+            header, img = _recordio.unpack_img(item)
             label = header.label
         else:
             label, path = self._records[idx]

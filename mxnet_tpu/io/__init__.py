@@ -87,6 +87,9 @@ class NDArrayIter(DataIter):
                  last_batch_handle="pad", data_name="data",
                  label_name="softmax_label"):
         super().__init__(batch_size)
+        if last_batch_handle not in ("pad", "discard", "roll_over"):
+            raise MXNetError(f"invalid last_batch_handle "
+                             f"{last_batch_handle!r}")
         self.data = self._init_data(data, data_name)
         self.label = self._init_data(label, label_name) if label is not None \
             else []
@@ -94,6 +97,7 @@ class NDArrayIter(DataIter):
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
+        self._leftover = onp.array([], dtype=onp.int64)
         self._order = onp.arange(self.num_data)
         if shuffle:
             onp.random.shuffle(self._order)
@@ -127,14 +131,25 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         self.cursor = -self.batch_size
+        base = onp.arange(self.num_data)
         if self.shuffle:
-            onp.random.shuffle(self._order)
+            onp.random.shuffle(base)
+        if self.last_batch_handle == "roll_over" and len(self._leftover):
+            # rolled-over samples lead the next epoch (reference semantics)
+            base = onp.concatenate([self._leftover, base])
+            self._leftover = onp.array([], dtype=onp.int64)
+        self._order = base
 
     def iter_next(self):
         self.cursor += self.batch_size
-        if self.last_batch_handle == "discard":
-            return self.cursor + self.batch_size <= self.num_data
-        return self.cursor < self.num_data
+        n = len(self._order)
+        if self.last_batch_handle == "pad":
+            return self.cursor < n
+        if self.cursor + self.batch_size <= n:
+            return True
+        if self.last_batch_handle == "roll_over" and self.cursor < n:
+            self._leftover = self._order[self.cursor:]
+        return False
 
     def _slice(self, arrays):
         out = []
@@ -155,9 +170,10 @@ class NDArrayIter(DataIter):
         return self._slice(self.label)
 
     def getpad(self):
+        n = len(self._order)
         if self.last_batch_handle == "pad" and \
-                self.cursor + self.batch_size > self.num_data:
-            return self.cursor + self.batch_size - self.num_data
+                self.cursor + self.batch_size > n:
+            return self.cursor + self.batch_size - n
         return 0
 
 
@@ -192,11 +208,23 @@ class ImageRecordIter(DataIter):
     augmentation happen in python worker threads.
     """
 
+    _KNOWN_KWARGS = frozenset({"preprocess_threads", "label_name",
+                               "data_name", "prefetch_buffer", "ctx",
+                               "dtype", "verbose", "num_parts", "part_index",
+                               "path_imgidx"})
+
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=-1, round_batch=True, seed=0, **kwargs):
         super().__init__(batch_size)
+        unknown = set(kwargs) - self._KNOWN_KWARGS
+        if unknown:
+            import warnings
+
+            warnings.warn(f"ImageRecordIter: ignoring unknown options "
+                          f"{sorted(unknown)}", stacklevel=2)
+        self._round_batch = round_batch
         self.data_shape = tuple(data_shape)
         self.label_width = label_width
         self._shuffle = shuffle
@@ -297,7 +325,10 @@ class ImageRecordIter(DataIter):
             imgs[i] = chw
             labels[i] = label
         pad = self.batch_size - n
-        if pad:
+        if pad and not self._round_batch:
+            imgs, labels = imgs[:n], labels[:n]  # short final batch
+            pad = 0
+        elif pad:
             imgs[n:] = imgs[:1]
             labels[n:] = labels[:1]
         lab = labels[:, 0] if self.label_width == 1 else labels
@@ -361,6 +392,7 @@ class PrefetchingIter(DataIter):
     def _start_worker(self):
         self._queue = []
         self._done = False
+        self._error = None
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
 
@@ -374,6 +406,9 @@ class PrefetchingIter(DataIter):
                         return
                     self._queue.append(batch)
                     self._cv.notify_all()
+        except BaseException as e:  # noqa: BLE001 — surface in consumer
+            with self._cv:
+                self._error = e
         finally:
             with self._cv:
                 self._queue.append(None)
@@ -396,6 +431,8 @@ class PrefetchingIter(DataIter):
             batch = self._queue.pop(0)
             self._cv.notify_all()
         if batch is None:
+            if self._error is not None:
+                raise self._error
             raise StopIteration
         return batch
 

@@ -77,7 +77,9 @@ class KVStore(KVStoreBase):
     def push(self, key, value, priority=0):
         keys, vals = _keys_vals(key, value)
         for k, v in zip(keys, vals):
-            red = self._reduce(v)
+            # reduce locally, then across workers (reference: server-side
+            # merge of all workers' pushes, kvstore_dist_server.h:346)
+            red = self._global_reduce(self._reduce(v))
             if self._updater is not None:
                 if k not in self._store:
                     self._store[k] = NDArray(red)
@@ -194,6 +196,7 @@ class Dist_Sync(KVStore):
         super().__init__(name)
         import jax
 
+        _ensure_distributed()
         self._nproc = jax.process_count()
         self._rank = jax.process_index()
 
@@ -225,6 +228,41 @@ class Dist_Sync(KVStore):
 class Dist_Device_Sync(Dist_Sync):
     def __init__(self):
         super().__init__("dist_device_sync")
+
+
+_dist_initialized = False
+
+
+def _ensure_distributed():
+    """Join the process group described by the launcher env (tools/launch.py
+    MXTPU_DIST_* contract — the reference's DMLC_ROLE/DMLC_PS_ROOT_URI
+    analog) if present and not already initialized."""
+    global _dist_initialized
+    import os
+
+    if _dist_initialized:
+        return
+    coord = os.environ.get("MXTPU_DIST_COORD")
+    if not coord:
+        return
+    import jax
+
+    try:
+        # must come before ANY backend-initializing call (even
+        # jax.process_count() counts)
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["MXTPU_DIST_NPROC"]),
+            process_id=int(os.environ["MXTPU_DIST_RANK"]))
+    except RuntimeError as e:
+        # tolerate ONLY the benign cases: distributed already initialized by
+        # the user, or a backend the user initialized deliberately — anything
+        # else (bad coordinator, mismatched world size) must fail loudly or
+        # workers would silently train unsynchronized
+        msg = str(e)
+        if "already" not in msg and "must be called before" not in msg:
+            raise MXNetError(f"jax.distributed.initialize failed: {e}") from e
+    _dist_initialized = True
 
 
 def create(name="local") -> KVStoreBase:

@@ -138,15 +138,16 @@ class RowSparseNDArray(BaseSparseNDArray):
         raise MXNetError(f"cannot convert row_sparse to {stype}")
 
     def retain(self, row_ids):
-        """Keep only the requested rows (reference: sparse_retain op)."""
-        import jax.numpy as jnp
-
-        rid = row_ids._data if isinstance(row_ids, NDArray) else \
-            jnp.asarray(row_ids)
-        # membership of stored indices in row_ids
-        dense = self.todense()
-        vals = dense._data[rid]
-        return RowSparseNDArray(NDArray(vals), NDArray(rid), self._shape)
+        """Keep only the stored rows listed in row_ids (reference:
+        sparse_retain op) — intersection semantics, no densification."""
+        rid = row_ids.asnumpy() if isinstance(row_ids, NDArray) else \
+            onp.asarray(row_ids)
+        stored = self.indices.asnumpy()
+        mask = onp.isin(stored, rid)
+        keep = onp.nonzero(mask)[0]
+        return RowSparseNDArray(
+            NDArray(self.data._data[keep]),
+            NDArray(stored[keep].astype(onp.int32)), self._shape)
 
     def __repr__(self):
         return (f"<RowSparseNDArray {self._shape} "

@@ -80,6 +80,12 @@ class Op:
             f = self._make_fn(**attrs)
             if amp_dt is not None:
                 f = _amp_wrap(f, amp_dt)
+            if _EAGER_JIT:
+                # jit each op fn: eager calls hit the compiled-program cache
+                # and jax.vjp linearizes against one cached pjit primitive
+                # instead of re-tracing op internals (e.g. RNN scans) every
+                # step — the per-op program cache of SURVEY §7
+                f = jax.jit(f)
             self._fn_cache[key] = f
         return f
 
@@ -116,7 +122,7 @@ def list_ops():
 # ---------------------------------------------------------------------------
 # invoke — the imperative chokepoint
 # ---------------------------------------------------------------------------
-_EAGER_JIT = os.environ.get("MXNET_EAGER_JIT", "0") == "1"
+_EAGER_JIT = os.environ.get("MXNET_EAGER_JIT", "1") == "1"
 
 
 class _TLS(threading.local):

@@ -21,7 +21,12 @@ __all__ = ["seed", "uniform", "normal", "randint", "randn", "rand",
            "lognormal", "multivariate_normal"]
 
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(0)
+# lazy: creating a PRNGKey initializes the XLA backend, which must not happen
+# at import time (breaks jax.distributed.initialize ordering and forces a
+# TPU handshake in processes that never compute — cf. reference fork-safety,
+# src/initialize.cc:71)
+_key = None
+_pending_seed = 0
 
 # host-side RNG for data-pipeline augmentation (vision transforms): seeded
 # together with the device PRNG so mx.random.seed makes augmentation
@@ -31,15 +36,18 @@ host_rng = onp.random.RandomState(0)
 
 def seed(seed_state: int):
     """Set the global seed (reference: mx.random.seed)."""
-    global _key
+    global _key, _pending_seed
     with _lock:
-        _key = jax.random.PRNGKey(int(seed_state))
+        _pending_seed = int(seed_state)
+        _key = jax.random.PRNGKey(_pending_seed)
         host_rng.seed(int(seed_state) & 0x7FFFFFFF)
 
 
 def _next_key():
     global _key
     with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(_pending_seed)
         _key, sub = jax.random.split(_key)
     return sub
 

@@ -1,0 +1,77 @@
+"""Distributed KVStore worker script (reference:
+tests/nightly/dist_sync_kvstore.py — check_diff asserts worker-count-scaled
+values after push/pull :66-73). Run via the local launcher:
+
+    python tools/launch.py -n 3 python tests/nightly/dist_sync_kvstore.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import kvstore, np  # noqa: E402
+
+
+def check_diff(arr, expected):
+    got = arr.asnumpy()
+    assert onp.allclose(got, expected), f"expected {expected}, got {got}"
+
+
+def main():
+    kv = kvstore.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == int(os.environ["MXTPU_DIST_NPROC"])
+
+    # pushpull sums contributions from every worker
+    shape = (3, 2)
+    grad = np.ones(shape) * (rank + 1)
+    out = np.zeros(shape)
+    kv.pushpull("key0", grad, out=out)
+    expected = sum(r + 1 for r in range(nworker))
+    check_diff(out, expected)
+
+    # a second round with different values
+    grad2 = np.full(shape, 2.0 * (rank + 1))
+    out2 = np.zeros(shape)
+    kv.pushpull("key1", grad2, out=out2)
+    check_diff(out2, 2.0 * expected)
+
+    # barrier then trainer-style flow: grads averaged into weights
+    kv.barrier()
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(7)  # identical init on every worker
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv)
+    x = np.ones((4, 3)) * (rank + 1)
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(4 * nworker)
+    # all workers must hold identical weights after the allreduced step
+    w = net.weight.data().asnumpy()
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(net.weight.data()._data)
+    for r in range(nworker):
+        assert onp.allclose(gathered[r], w, atol=1e-6), \
+            "weights diverged across workers"
+    print(f"worker {rank}/{nworker}: dist_sync kvstore OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,7 @@
 """KVStore semantics (reference: tests/python/unittest/test_kvstore.py,
 test_kvstore_custom.py)."""
+import os
+
 import numpy as onp
 import pytest
 
@@ -113,3 +115,23 @@ def test_trainer_with_kvstore():
     w_before = net.weight.data().asnumpy().copy()
     trainer.step(4)
     assert not onp.allclose(w_before, net.weight.data().asnumpy())
+
+
+@pytest.mark.integration
+def test_dist_sync_multiprocess_launcher():
+    """The reference's multi-node-without-cluster recipe (SURVEY §4):
+    tools/launch.py spawns 3 workers wired by jax.distributed."""
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # workers manage their own device counts
+    res = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "launch.py"), "-n", "3",
+         sys.executable, os.path.join(root, "tests", "nightly",
+                                      "dist_sync_kvstore.py")],
+        capture_output=True, text=True, timeout=240, env=env)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("dist_sync kvstore OK") == 3

@@ -73,16 +73,23 @@ def test_optimizer_minimizes_quadratic(name):
         kwargs["learning_rate"] = 10.0  # trust ratio ~ eta*|w|/|g| is tiny
     if name == "sgld":
         kwargs["learning_rate"] = 0.01
+        mx.random.seed(42)  # Langevin noise: pin the seed for determinism
     opt = optimizer.create(name, **kwargs)
     target = onp.array([1.0, -2.0, 3.0], "float32")
     # start away from zero: norm-scaled optimizers (lamb/lars) freeze at w=0
     w = NDArray(onp.full(3, 0.5, "float32"))
     state = opt.create_state(0, w)
-    for _ in range(500):
+    tail = []
+    for i in range(500):
         g = NDArray(2 * (w.asnumpy() - target))
         opt.update(0, w, g, state)
-    err = onp.abs(w.asnumpy() - target).max()
-    tol = 1.5 if name == "sgld" else 0.35
+        if i >= 450:
+            tail.append(w.asnumpy().copy())
+    # SGLD samples a posterior: judge the mean of late iterates, not the
+    # final noisy sample
+    final = onp.mean(tail, axis=0) if name == "sgld" else w.asnumpy()
+    err = onp.abs(final - target).max()
+    tol = 0.8 if name == "sgld" else 0.35
     assert err < tol, f"{name}: final error {err}"
 
 

@@ -16,17 +16,27 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as onp  # noqa: E402
 
 
-def make_list(prefix, root, recursive=True, exts=(".jpg", ".jpeg", ".png",
-                                                  ".npy")):
+def make_list(prefix, root, recursive=False, exts=(".jpg", ".jpeg", ".png",
+                                                   ".npy")):
+    """One class per top-level folder; --recursive walks nested dirs too."""
     items = []
     classes = sorted(d for d in os.listdir(root)
                      if os.path.isdir(os.path.join(root, d)))
     for label, cls in enumerate(classes):
         folder = os.path.join(root, cls)
-        for fname in sorted(os.listdir(folder)):
-            if fname.lower().endswith(exts):
-                items.append((len(items), label,
-                              os.path.join(cls, fname)))
+        if recursive:
+            files = []
+            for dirpath, _, fnames in os.walk(folder):
+                for fname in fnames:
+                    files.append(os.path.relpath(
+                        os.path.join(dirpath, fname), root))
+            files.sort()
+        else:
+            files = [os.path.join(cls, f)
+                     for f in sorted(os.listdir(folder))]
+        for rel in files:
+            if rel.lower().endswith(exts):
+                items.append((len(items), label, rel))
     with open(prefix + ".lst", "w") as f:
         for idx, label, path in items:
             f.write(f"{idx}\t{label}\t{path}\n")
@@ -58,9 +68,11 @@ def main():
     ap.add_argument("root")
     ap.add_argument("--list", action="store_true",
                     help="only generate the .lst file")
+    ap.add_argument("--recursive", action="store_true",
+                    help="walk nested directories under each class folder")
     ap.add_argument("--quality", type=int, default=95)
     args = ap.parse_args()
-    make_list(args.prefix, args.root)
+    make_list(args.prefix, args.root, recursive=args.recursive)
     if not args.list:
         make_rec(args.prefix, args.root, args.quality)
 
