@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Per-operator latency harness (reference: benchmark/opperf/opperf.py —
+runs every registered op with profiler timing).
+
+Times each op's eager dispatch (compiled-cache hit path) on the local device
+with canonical inputs. Output: one JSON line per op, or a table with --table.
+
+    python benchmark/opperf.py [--ops add,matmul,...] [--table] [--size 1024]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as onp  # noqa: E402
+
+
+def op_specs(n):
+    """Canonical inputs per op family (shapes sized by --size)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import np
+
+    sq = (n, n)
+    vec = np.array(onp.random.uniform(0.5, 1.5, sq).astype("float32"))
+    vec2 = np.array(onp.random.uniform(0.5, 1.5, sq).astype("float32"))
+    idx = np.array(onp.random.randint(0, n, (n,)))
+    specs = {}
+    unary = ["abs", "exp", "log", "sqrt", "square", "sin", "cos", "tanh",
+             "sigmoid", "relu", "erf", "floor", "negative", "reciprocal"]
+    for name in unary:
+        specs[name] = ([vec], {})
+    binary = ["add", "subtract", "multiply", "true_divide", "maximum",
+              "minimum", "power"]
+    for name in binary:
+        specs[name] = ([vec, vec2], {})
+    specs["matmul"] = ([vec, vec2], {})
+    specs["dot"] = ([vec, vec2], {})
+    specs["sum"] = ([vec], {"axis": None, "keepdims": False})
+    specs["mean"] = ([vec], {"axis": None, "keepdims": False})
+    specs["max"] = ([vec], {"axis": 1, "keepdims": False})
+    specs["argmax"] = ([vec], {"axis": 1, "keepdims": False})
+    specs["softmax"] = ([vec], {"axis": -1})
+    specs["log_softmax"] = ([vec], {"axis": -1})
+    specs["transpose"] = ([vec], {"axes": None})
+    specs["reshape"] = ([vec], {"newshape": (n * n,)})
+    specs["concatenate"] = ([vec, vec2], {"axis": 0})
+    specs["sort"] = ([vec], {"axis": -1})
+    specs["take"] = ([vec, idx], {"axis": 0, "mode": "clip"})
+    specs["cumsum"] = ([vec], {"axis": 1})
+    specs["layer_norm"] = (
+        [vec, np.ones((n,)), np.zeros((n,))], {"axis": -1, "eps": 1e-5})
+    specs["einsum"] = ([vec, vec2], {"subscripts": "ij,jk->ik"})
+    return specs
+
+
+def sync(arr):
+    return onp.asarray(arr._data.ravel()[0])
+
+
+def bench_op(name, args, attrs, warmup=3, iters=20):
+    from mxnet_tpu.ops.registry import apply_op
+
+    for _ in range(warmup):
+        out = apply_op(name, *args, **attrs)
+        out = out[0] if isinstance(out, tuple) else out
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = apply_op(name, *args, **attrs)
+        out = out[0] if isinstance(out, tuple) else out
+    sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default=None,
+                    help="comma-separated subset (default: all specs)")
+    ap.add_argument("--size", type=int, default=1024)
+    ap.add_argument("--table", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    specs = op_specs(args.size)
+    names = args.ops.split(",") if args.ops else sorted(specs)
+    results = []
+    for name in names:
+        if name not in specs:
+            print(f"# no spec for op {name!r}", file=sys.stderr)
+            continue
+        op_args, attrs = specs[name]
+        try:
+            dt = bench_op(name, op_args, attrs)
+        except Exception as e:  # noqa: BLE001
+            print(f"# {name} failed: {e}", file=sys.stderr)
+            continue
+        results.append({"op": name, "avg_time_ms": round(dt * 1e3, 4),
+                        "backend": jax.default_backend(),
+                        "size": args.size})
+    if args.table:
+        print(f"{'op':<20}{'avg ms':>12}")
+        for r in results:
+            print(f"{r['op']:<20}{r['avg_time_ms']:>12.4f}")
+    else:
+        for r in results:
+            print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,8 @@ from . import recordio
 from . import operator
 from . import library
 from . import subgraph
+from . import contrib
+from . import rtc
 from . import visualization
 from . import callback
 from . import model

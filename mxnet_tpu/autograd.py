@@ -116,13 +116,21 @@ class AGInfo:
 
 
 class _TapeNode:
-    __slots__ = ("vjp", "in_infos", "out_avals", "seq", "multi")
+    __slots__ = ("vjp", "in_infos", "out_avals", "seq", "multi", "fn",
+                 "inputs", "_cg_op")
 
-    def __init__(self, vjp, in_infos, out_avals, multi):
+    def __init__(self, vjp, in_infos, out_avals, multi, fn=None, inputs=()):
         self.vjp = vjp
         self.in_infos = in_infos
         self.out_avals = out_avals  # tuple of (shape, dtype) per output
         self.multi = multi  # fn returned a tuple (vjp cotangent must match)
+        # fn + primal inputs retained for create_graph: higher-order grads
+        # must re-differentiate through the primal computation, which the
+        # opaque vjp closure cannot provide (reference: higher-order grad
+        # support through repeated MXGradient passes)
+        self.fn = fn
+        self.inputs = inputs
+        self._cg_op = None  # cached create-graph vjp Op (avoids re-jit per walk)
         self.seq = next(_seq)
 
 
@@ -140,6 +148,8 @@ def _record_op(fn, inputs, datas):
         ),
         out_avals=tuple((o.shape, o.dtype) for o in outs),
         multi=multi,
+        fn=fn,
+        inputs=tuple(inputs),
     )
     return out_data, node
 
@@ -169,18 +179,54 @@ def _zero_cotangent(shape, dtype):
     return onp.zeros(shape, dtype=jax.dtypes.float0)
 
 
-def _walk(heads, head_grads):
-    """Reverse-order tape walk. Returns {id(variable_ndarray): cotangent}."""
+def _node_vjp_op(node):
+    """Registry Op computing a node's input cotangents FROM ITS PRIMALS, so
+    the cotangent computation is itself recordable (create_graph). Cached on
+    the node: repeat walks hit the same jitted program."""
     import jax.numpy as jnp
 
-    # cotangent accumulators
+    from .ops.registry import Op
+
+    if node._cg_op is not None:
+        return node._cg_op
+    n_in = len(node.inputs)
+    multi = node.multi
+    fn = node.fn
+
+    def f(*args):
+        primals, cots_ = args[:n_in], args[n_in:]
+        _, vjp = jax.vjp(fn, *primals)
+        outs = vjp(tuple(cots_) if multi else cots_[0])
+        # float0 cotangents (int inputs) cannot be op outputs
+        return tuple(
+            o if getattr(o, "dtype", None) != jax.dtypes.float0
+            else jnp.zeros(o.shape, jnp.float32) for o in outs)
+
+    node._cg_op = Op("vjp_node", lambda **a: f)
+    return node._cg_op
+
+
+def _walk(heads, head_grads, create_graph=False):
+    """Reverse-order tape walk. Returns {id(variable_ndarray): cotangent}.
+
+    With ``create_graph`` the cotangents are NDArrays and every backward
+    computation routes through the op registry, producing fresh tape nodes
+    (higher-order gradients) — the analog of the reference building the grad
+    graph from differentiable FGradient nodes.
+    """
+    import heapq
+
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
     node_cots: dict[int, dict[int, object]] = {}  # id(node) -> {out_idx: cot}
     var_cots: dict[int, object] = {}  # id(var NDArray) -> cot
     nodes: dict[int, _TapeNode] = {}
     var_refs: dict[int, object] = {}
 
     def _sow(info, cot):
-        if info is None:
+        if info is None or cot is None:
             return
         if info.variable is not None:
             v = info.variable
@@ -202,16 +248,20 @@ def _walk(heads, head_grads):
                 "recorded computation (did you call backward outside "
                 "autograd.record(), or forget attach_grad?)"
             )
-        if hg is None:
-            hg = jnp.ones(h.shape, h.dtype)
+        if create_graph:
+            if hg is None:
+                hg = NDArray(jnp.ones(h.shape, h.dtype))
+            elif not isinstance(hg, NDArray):
+                hg = NDArray(jnp.asarray(hg))
         else:
-            hg = hg._data if hasattr(hg, "_data") else jnp.asarray(hg)
+            if hg is None:
+                hg = jnp.ones(h.shape, h.dtype)
+            else:
+                hg = hg._data if hasattr(hg, "_data") else jnp.asarray(hg)
         _sow(info, hg)
 
     # reverse execution order == valid reverse topological order; a max-heap
     # on seq processes each node after all its consumers (they ran later)
-    import heapq
-
     heap = [(-n.seq, id(n)) for n in nodes.values()]
     heapq.heapify(heap)
     done = set()
@@ -222,14 +272,30 @@ def _walk(heads, head_grads):
         done.add(nid)
         node = nodes[nid]
         cots = node_cots.get(id(node), {})
-        full = tuple(
-            cots.get(i, _zero_cotangent(shape, dtype))
-            for i, (shape, dtype) in enumerate(node.out_avals)
-        )
-        arg = full if node.multi else full[0]
-        in_cots = node.vjp(arg)
+        if create_graph:
+            if node.fn is None:
+                raise MXNetError("create_graph unsupported for this op "
+                                 "(no stored primal fn)")
+            from .ops.registry import invoke
+
+            full = [cots.get(i) for i in range(len(node.out_avals))]
+            for i, c in enumerate(full):
+                if c is None:
+                    shape, dtype = node.out_avals[i]
+                    full[i] = NDArray(jnp.zeros(shape, dtype))
+            in_cots = invoke(_node_vjp_op(node),
+                             list(node.inputs) + full, {})
+            if not isinstance(in_cots, tuple):
+                in_cots = (in_cots,)
+        else:
+            full = tuple(
+                cots.get(i, _zero_cotangent(shape, dtype))
+                for i, (shape, dtype) in enumerate(node.out_avals)
+            )
+            in_cots = node.vjp(full if node.multi else full[0])
         for info, cot in zip(node.in_infos, in_cots):
-            if info is None or getattr(cot, "dtype", None) == jax.dtypes.float0:
+            if info is None or \
+                    getattr(cot, "dtype", None) == jax.dtypes.float0:
                 continue
             if info.node is not None and id(info.node) not in nodes:
                 nodes[id(info.node)] = info.node
@@ -261,7 +327,11 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
-    """Return gradients of heads w.r.t. variables (reference autograd.py:272)."""
+    """Return gradients of heads w.r.t. variables (reference autograd.py:272).
+
+    With ``create_graph=True`` the returned gradients are themselves recorded
+    so they can be differentiated again (higher-order gradients).
+    """
     from .ndarray.ndarray import NDArray
 
     single = not isinstance(variables, (list, tuple))
@@ -271,15 +341,21 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             raise MXNetError("autograd.grad: variables must have attached grads "
                              "or be marked via mark_variables")
     heads, head_grads = _normalize_heads(heads, head_grads)
-    _, var_cots = _walk(heads, head_grads)
+    if create_graph:
+        with _scope(recording=True, training=train_mode):
+            _, var_cots = _walk(heads, head_grads, create_graph=True)
+    else:
+        _, var_cots = _walk(heads, head_grads)
     outs = []
     for v in var_list:
         cot = var_cots.get(id(v))
         if cot is None:
             import jax.numpy as jnp
 
-            cot = jnp.zeros(v.shape, v.dtype)
-        outs.append(NDArray(cot))
+            cot = NDArray(jnp.zeros(v.shape, v.dtype))
+        elif not isinstance(cot, NDArray):
+            cot = NDArray(cot)
+        outs.append(cot)
     return outs[0] if single else outs
 
 

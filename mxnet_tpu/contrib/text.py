@@ -1,0 +1,106 @@
+"""Text utilities: vocabulary + embeddings (reference: python/mxnet/contrib/
+text — vocab.Vocabulary, embedding.TokenEmbedding).
+
+Zero-egress note: pretrained embedding downloads are unavailable;
+CustomEmbedding loads local files with the same API.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["Vocabulary", "CustomEmbedding", "count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False):
+    if to_lower:
+        source_str = source_str.lower()
+    tokens = source_str.replace(seq_delim, token_delim).split(token_delim)
+    return collections.Counter(t for t in tokens if t)
+
+
+class Vocabulary:
+    """Token <-> index mapping (reference: text/vocab.py)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        self.unknown_token = unknown_token
+        self._idx_to_token = [unknown_token] + list(reserved_tokens or [])
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for token, freq in pairs:
+                if freq >= min_freq and token not in self._idx_to_token:
+                    self._idx_to_token.append(token)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idx = [indices] if single else indices
+        for i in idx:
+            if i >= len(self):
+                raise MXNetError(f"index {i} out of vocabulary")
+        out = [self._idx_to_token[i] for i in idx]
+        return out[0] if single else out
+
+
+class CustomEmbedding:
+    """Embeddings from a local text file: 'token v1 v2 ...' per line
+    (reference: text/embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", vocabulary=None):
+        vectors = {}
+        dim = None
+        with open(pretrained_file_path) as f:
+            for line in f:
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                token, vals = parts[0], [float(v) for v in parts[1:]]
+                if dim is None:
+                    dim = len(vals)
+                if len(vals) == dim:
+                    vectors[token] = vals
+        self.vec_len = dim or 0
+        if vocabulary is None:
+            counter = collections.Counter({t: 1 for t in vectors})
+            vocabulary = Vocabulary(counter)
+        self.vocabulary = vocabulary
+        table = onp.zeros((len(vocabulary), self.vec_len), dtype="float32")
+        for token, vals in vectors.items():
+            idx = vocabulary.token_to_idx.get(token)
+            if idx is not None:
+                table[idx] = vals
+        self.idx_to_vec = NDArray(table)
+
+    def get_vecs_by_tokens(self, tokens):
+        idx = self.vocabulary.to_indices(tokens)
+        single = isinstance(idx, int)
+        import jax.numpy as jnp
+
+        rows = self.idx_to_vec._data[jnp.asarray([idx] if single else idx)]
+        out = NDArray(rows[0] if single else rows)
+        return out

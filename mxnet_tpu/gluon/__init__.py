@@ -9,6 +9,7 @@ from .. import metric  # gluon.metric parity (reference moved metrics here)
 from . import rnn
 from . import model_zoo
 from . import contrib
+from . import probability
 
 __all__ = ["Parameter", "Constant", "DeferredInitializationError", "Block",
            "HybridBlock", "SymbolBlock", "Trainer", "nn", "loss", "data",

@@ -1,0 +1,18 @@
+"""gluon.probability — distributions, transformations, stochastic blocks.
+
+Reference: python/mxnet/gluon/probability/ (distributions with log_prob /
+sample / KL registry, StochasticBlock). TPU-native: densities use
+jax.scipy.stats where available; sampling draws from the framework PRNG
+(mx.random) so mx.random.seed governs reproducibility; reparameterized
+samples (sample_n with gradients) use the explicit-key pattern.
+"""
+from .distributions import (Distribution, Normal, Bernoulli, Categorical,
+                            Gamma, Exponential, Poisson, Uniform, Laplace,
+                            MultivariateNormal, kl_divergence,
+                            register_kl)
+from .stochastic_block import StochasticBlock
+
+__all__ = ["Distribution", "Normal", "Bernoulli", "Categorical", "Gamma",
+           "Exponential", "Poisson", "Uniform", "Laplace",
+           "MultivariateNormal", "kl_divergence", "register_kl",
+           "StochasticBlock"]

@@ -1,0 +1,365 @@
+"""Probability distributions (reference: gluon/probability/distributions/)."""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ... import np as _np
+from ... import random as _random
+
+__all__ = ["Distribution", "Normal", "Bernoulli", "Categorical", "Gamma",
+           "Exponential", "Poisson", "Uniform", "Laplace",
+           "MultivariateNormal", "kl_divergence", "register_kl"]
+
+
+def _nd(x):
+    if isinstance(x, NDArray):
+        return x
+    return _np.array(x)
+
+
+class Distribution:
+    """Base distribution (reference: distribution.py Distribution)."""
+
+    has_grad = True
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _np.exp(self.log_prob(value))
+
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, n):
+        return self.sample((n,))
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return _np.sqrt(self.variance)
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc = _nd(loc)
+        self.scale = _nd(scale)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - _np.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def sample(self, size=None):
+        shape = self._shape(size)
+        eps = _random.normal(size=shape)
+        return self.loc + eps * self.scale  # reparameterized
+
+    def _shape(self, size):
+        base = self.loc.shape if self.loc.ndim else ()
+        if size is None:
+            return base or (1,)
+        size = (size,) if isinstance(size, int) else tuple(size)
+        return size + base
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + _np.log(self.scale)
+
+
+class Laplace(Normal):
+    def log_prob(self, value):
+        value = _nd(value)
+        return -_np.abs(value - self.loc) / self.scale - \
+            _np.log(2 * self.scale)
+
+    def sample(self, size=None):
+        u = _random.uniform(-0.5, 0.5, size=self._shape(size))
+        return self.loc - self.scale * _np.sign(u) * \
+            _np.log1p(-2 * _np.abs(u))
+
+    @property
+    def variance(self):
+        return 2 * self.scale ** 2
+
+    def entropy(self):
+        return 1 + _np.log(2 * self.scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low=0.0, high=1.0):
+        self.low = _nd(low)
+        self.high = _nd(high)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        inside = _np.logical_and(value >= self.low, value <= self.high)
+        return _np.where(inside, -_np.log(self.high - self.low),
+                         _np.full_like(value, -onp.inf))
+
+    def sample(self, size=None):
+        shape = size if size is not None else \
+            (self.low.shape or (1,))
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        u = _random.uniform(0.0, 1.0, size=shape)
+        return self.low + u * (self.high - self.low)
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2
+
+    @property
+    def variance(self):
+        return (self.high - self.low) ** 2 / 12
+
+    def entropy(self):
+        return _np.log(self.high - self.low)
+
+
+class Bernoulli(Distribution):
+    has_grad = False
+
+    def __init__(self, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("specify exactly one of prob/logit")
+        if prob is not None:
+            self.prob_ = _nd(prob)
+        else:
+            from ... import numpy_extension as npx
+
+            self.prob_ = npx.sigmoid(_nd(logit))
+
+    def log_prob(self, value):
+        value = _nd(value)
+        eps = 1e-12
+        return value * _np.log(self.prob_ + eps) + \
+            (1 - value) * _np.log(1 - self.prob_ + eps)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self.prob_.shape
+        u = _random.uniform(size=shape)
+        return (u < self.prob_).astype("float32")
+
+    @property
+    def mean(self):
+        return self.prob_
+
+    @property
+    def variance(self):
+        return self.prob_ * (1 - self.prob_)
+
+    def entropy(self):
+        eps = 1e-12
+        p = self.prob_
+        return -(p * _np.log(p + eps) + (1 - p) * _np.log(1 - p + eps))
+
+
+class Categorical(Distribution):
+    has_grad = False
+
+    def __init__(self, prob=None, logit=None):
+        if (prob is None) == (logit is None):
+            raise MXNetError("specify exactly one of prob/logit")
+        from ... import numpy_extension as npx
+
+        if prob is not None:
+            self.prob_ = _nd(prob)
+            self.logit_ = _np.log(self.prob_ + 1e-12)
+        else:
+            self.logit_ = _nd(logit)
+            self.prob_ = npx.softmax(self.logit_, axis=-1)
+
+    def log_prob(self, value):
+        from ... import numpy_extension as npx
+
+        logp = npx.log_softmax(self.logit_, axis=-1)
+        return npx.pick(logp, _nd(value), axis=-1)
+
+    def sample(self, size=None):
+        out = _random.categorical(self.logit_, size=size)
+        return out.astype("float32")
+
+    @property
+    def mean(self):
+        raise MXNetError("categorical mean undefined")
+
+    def entropy(self):
+        from ... import numpy_extension as npx
+
+        logp = npx.log_softmax(self.logit_, axis=-1)
+        return -(self.prob_ * logp).sum(axis=-1)
+
+
+class Exponential(Distribution):
+    def __init__(self, scale=1.0):
+        self.scale = _nd(scale)
+
+    def log_prob(self, value):
+        return -_np.log(self.scale) - _nd(value) / self.scale
+
+    def sample(self, size=None):
+        shape = size if size is not None else self.scale.shape or (1,)
+        return _random.exponential(self.scale, size=shape)
+
+    @property
+    def mean(self):
+        return self.scale
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def entropy(self):
+        return 1 + _np.log(self.scale)
+
+
+class Gamma(Distribution):
+    def __init__(self, shape, scale=1.0):
+        self.shape_ = _nd(shape)
+        self.scale = _nd(scale)
+
+    def log_prob(self, value):
+        from ... import numpy_extension as npx
+
+        value = _nd(value)
+        a = self.shape_
+        return (a - 1) * _np.log(value) - value / self.scale - \
+            npx.gammaln(a) - a * _np.log(self.scale)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self.shape_.shape or None
+        return _random.gamma(self.shape_, self.scale, size=shape)
+
+    @property
+    def mean(self):
+        return self.shape_ * self.scale
+
+    @property
+    def variance(self):
+        return self.shape_ * self.scale ** 2
+
+
+class Poisson(Distribution):
+    has_grad = False
+
+    def __init__(self, rate=1.0):
+        self.rate = _nd(rate)
+
+    def log_prob(self, value):
+        from ... import numpy_extension as npx
+
+        value = _nd(value)
+        return value * _np.log(self.rate) - self.rate - \
+            npx.gammaln(value + 1)
+
+    def sample(self, size=None):
+        shape = size if size is not None else self.rate.shape or (1,)
+        return _random.poisson(self.rate, size=shape).astype("float32")
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, cov):
+        self.loc = _nd(loc)
+        self.cov = _nd(cov)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        d = self.loc.shape[-1]
+        diff = value - self.loc
+        sol = _np.linalg.solve(self.cov, diff.reshape((-1, d)).T).T
+        maha = (diff.reshape((-1, d)) * sol).sum(axis=-1)
+        _, logdet = _np.linalg.slogdet(self.cov)
+        return -0.5 * (maha + d * math.log(2 * math.pi) + logdet)
+
+    def sample(self, size=None):
+        return _random.multivariate_normal(self.loc, self.cov, size=size)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _np.diagonal(self.cov)
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: gluon/probability divergence registry)
+# ---------------------------------------------------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return decorator
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise MXNetError(f"no KL registered for "
+                         f"({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - _np.log(var_ratio))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    eps = 1e-12
+    a, b = p.prob_, q.prob_
+    return a * _np.log((a + eps) / (b + eps)) + \
+        (1 - a) * _np.log((1 - a + eps) / (1 - b + eps))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    from ... import numpy_extension as npx
+
+    lp = npx.log_softmax(p.logit_, axis=-1)
+    lq = npx.log_softmax(q.logit_, axis=-1)
+    return (p.prob_ * (lp - lq)).sum(axis=-1)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    r = p.scale / q.scale
+    return -_np.log(r) + r - 1

@@ -1,0 +1,31 @@
+"""StochasticBlock: blocks with auxiliary losses (reference:
+gluon/probability/block/stochastic_block.py)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock"]
+
+
+class StochasticBlock(HybridBlock):
+    """A HybridBlock that can register intermediate losses during forward
+    (e.g. KL terms in a VAE). Use ``self.add_loss`` inside forward and read
+    ``.losses`` after calling the block."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._flushed_losses = []
+        self._pending = []
+
+    def add_loss(self, loss):
+        self._pending.append(loss)
+
+    @property
+    def losses(self):
+        return self._flushed_losses
+
+    def __call__(self, *args, **kwargs):
+        self._pending = []
+        out = super().__call__(*args, **kwargs)
+        self._flushed_losses = list(self._pending)
+        return out

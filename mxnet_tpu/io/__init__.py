@@ -390,37 +390,43 @@ class PrefetchingIter(DataIter):
         self._start_worker()
 
     def _start_worker(self):
+        # generation-scoped state: a stale worker from a previous epoch holds
+        # references to ITS OWN queue/flag objects, so even if it outlives a
+        # reset it can never pollute the new epoch's queue
         self._queue = []
-        self._done = False
+        self._done = [False]
         self._error = None
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._done),
+            daemon=True)
         self._thread.start()
 
-    def _worker(self):
+    def _worker(self, queue, done):
         try:
             for batch in self._iter:
                 with self._cv:
-                    while len(self._queue) >= 2 and not self._done:
+                    while len(queue) >= 2 and not done[0]:
                         self._cv.wait(0.1)
-                    if self._done:
+                    if done[0]:
                         return
-                    self._queue.append(batch)
+                    queue.append(batch)
                     self._cv.notify_all()
         except BaseException as e:  # noqa: BLE001 — surface in consumer
             with self._cv:
-                self._error = e
+                if not done[0]:
+                    self._error = e
         finally:
             with self._cv:
-                self._queue.append(None)
+                queue.append(None)
                 self._cv.notify_all()
 
     def reset(self):
         """Stop the worker, reset the wrapped iterator, start a new epoch."""
         with self._cv:
-            self._done = True
+            self._done[0] = True
             self._cv.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            self._thread.join(timeout=30.0)
         self._iter.reset()
         self._start_worker()
 
@@ -437,4 +443,7 @@ class PrefetchingIter(DataIter):
         return batch
 
     def __del__(self):
-        self._done = True
+        try:
+            self._done[0] = True
+        except Exception:  # noqa: BLE001
+            pass

@@ -262,6 +262,14 @@ def _ensure_distributed():
         msg = str(e)
         if "already" not in msg and "must be called before" not in msg:
             raise MXNetError(f"jax.distributed.initialize failed: {e}") from e
+    expected = int(os.environ["MXTPU_DIST_NPROC"])
+    if jax.process_count() != expected:
+        raise MXNetError(
+            f"launched with MXTPU_DIST_NPROC={expected} but "
+            f"jax.process_count()={jax.process_count()} — the backend was "
+            "initialized before kvstore.create('dist_sync') could join the "
+            "process group; create the kvstore (or call "
+            "jax.distributed.initialize) before any JAX computation")
     _dist_initialized = True
 
 

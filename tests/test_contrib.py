@@ -1,0 +1,170 @@
+"""contrib + probability + rtc (reference: test suites for
+gluon/probability, contrib/text, contrib/quantization)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ---------------------------------------------------------------- probability
+def test_normal_distribution():
+    from mxnet_tpu.gluon.probability import Normal
+
+    d = Normal(loc=np.array([0.0]), scale=np.array([2.0]))
+    lp = d.log_prob(np.array([0.0]))
+    ref = -0.5 * onp.log(2 * onp.pi * 4)
+    assert_almost_equal(lp, [ref], rtol=1e-5, atol=1e-5)
+    mx.random.seed(0)
+    samples = d.sample((5000,))
+    assert abs(float(samples.mean())) < 0.15
+    assert abs(float(samples.std()) - 2.0) < 0.15
+    assert_almost_equal(d.variance, [4.0])
+
+
+def test_normal_reparameterized_grad():
+    from mxnet_tpu.gluon.probability import Normal
+
+    loc = np.array([1.0])
+    scale = np.array([0.5])
+    loc.attach_grad()
+    scale.attach_grad()
+    with autograd.record():
+        d = Normal(loc, scale)
+        s = d.sample((100,)).mean()
+    s.backward()
+    assert abs(float(loc.grad) - 1.0) < 1e-4  # d mean / d loc = 1
+
+
+def test_bernoulli_categorical():
+    from mxnet_tpu.gluon.probability import Bernoulli, Categorical
+
+    b = Bernoulli(prob=np.array([0.7]))
+    assert_almost_equal(b.mean, [0.7])
+    lp = b.log_prob(np.array([1.0]))
+    assert_almost_equal(lp, [onp.log(0.7)], rtol=1e-5, atol=1e-5)
+    c = Categorical(prob=np.array([0.2, 0.3, 0.5]))
+    lp = c.log_prob(np.array(2))
+    assert_almost_equal(lp, onp.log(0.5), rtol=1e-4, atol=1e-4)
+    ent = c.entropy()
+    ref = -sum(p * onp.log(p) for p in (0.2, 0.3, 0.5))
+    assert_almost_equal(ent, ref, rtol=1e-4, atol=1e-4)
+    with pytest.raises(MXNetError):
+        Bernoulli(prob=0.5, logit=0.0)
+
+
+def test_kl_divergence():
+    from mxnet_tpu.gluon.probability import Normal, kl_divergence
+
+    p = Normal(np.array([0.0]), np.array([1.0]))
+    q = Normal(np.array([1.0]), np.array([1.0]))
+    assert_almost_equal(kl_divergence(p, q), [0.5])
+    assert_almost_equal(kl_divergence(p, p), [0.0])
+
+
+def test_stochastic_block_vae_style():
+    from mxnet_tpu.gluon.probability import (Normal, StochasticBlock,
+                                             kl_divergence)
+    from mxnet_tpu.gluon import nn
+
+    class Encoder(StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.mu = nn.Dense(2, in_units=4)
+            self.ls = nn.Dense(2, in_units=4)
+
+        def forward(self, x):
+            mu = self.mu(x)
+            scale = np.exp(self.ls(x))
+            q = Normal(mu, scale)
+            prior = Normal(np.zeros_like(mu), np.ones_like(scale))
+            self.add_loss(kl_divergence(q, prior).sum())
+            return q.sample()
+
+    enc = Encoder()
+    enc.initialize()
+    z = enc(np.ones((3, 4)))
+    assert z.shape[-1] == 2
+    assert len(enc.losses) == 1
+
+
+def test_distributions_sampling_shapes():
+    from mxnet_tpu.gluon import probability as pb
+
+    assert pb.Exponential(np.array([2.0])).sample((7,)).shape[0] == 7
+    assert pb.Uniform(0.0, 1.0).sample((5,)).shape == (5,)
+    assert pb.Gamma(np.array([2.0])).sample((4,)).shape[0] == 4
+    assert pb.Poisson(np.array([3.0])).sample((6,)).shape[0] == 6
+    assert pb.Laplace(np.array([0.0]), np.array([1.0])).sample(
+        (3,)).shape[0] == 3
+
+
+# ---------------------------------------------------------------- text
+def test_vocab_and_embedding(tmp_path):
+    from mxnet_tpu.contrib import text
+
+    counter = text.count_tokens_from_str("the cat sat on the mat the end")
+    vocab = text.Vocabulary(counter, min_freq=1)
+    assert vocab.to_indices("the") == 1  # most frequent after <unk>
+    assert vocab.to_tokens(1) == "the"
+    assert vocab.to_indices("zzz") == 0  # unknown
+    emb_file = tmp_path / "emb.txt"
+    emb_file.write_text("cat 1.0 2.0\nmat 3.0 4.0\n")
+    emb = text.CustomEmbedding(str(emb_file), vocabulary=vocab)
+    v = emb.get_vecs_by_tokens("cat")
+    assert v.asnumpy().tolist() == [1.0, 2.0]
+    vs = emb.get_vecs_by_tokens(["cat", "mat"])
+    assert vs.shape == (2, 2)
+
+
+# ---------------------------------------------------------------- quantization
+def test_quantize_dequantize_roundtrip():
+    from mxnet_tpu.contrib import quantization as q
+
+    x = np.array(onp.random.uniform(-3, 3, (8, 8)).astype("float32"))
+    qx, scale = q.quantize(x)
+    assert str(qx.dtype) == "int8"
+    back = q.dequantize(qx, scale)
+    assert float(abs(back - x).max()) < 3.0 / 127 * 1.5
+
+
+def test_quantize_net():
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    x = mx.np.random.uniform(size=(2, 8))
+    ref = net(x).asnumpy()
+    q.quantize_net(net)
+    got = net(x).asnumpy()
+    assert onp.abs(ref - got).max() < 0.1  # int8 weight error bound
+
+
+# ---------------------------------------------------------------- rtc
+def test_pallas_module():
+    from mxnet_tpu import rtc
+
+    src = """
+def axpy(x_ref, y_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+"""
+    mod = rtc.CudaModule(src)
+    kernel = mod.get_kernel("axpy", out_shapes=[(4,)])
+    out = kernel.launch([np.array([1.0, 2.0, 3.0, 4.0]),
+                         np.array([10.0, 10.0, 10.0, 10.0])])
+    assert_almost_equal(out, [12.0, 14.0, 16.0, 18.0])
+    with pytest.raises(MXNetError):
+        rtc.CudaModule("__global__ void k(float* x) {}")
+
+
+def test_onnx_gated():
+    from mxnet_tpu.contrib import onnx
+
+    if not onnx.HAS_ONNX:
+        with pytest.raises(MXNetError):
+            onnx.export_model(None, None)

@@ -1,0 +1,72 @@
+"""Higher-order gradients via create_graph (reference:
+tests/python/unittest/test_higher_order_grad.py)."""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import np, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _nth_grad(fn, x_np, order):
+    x = np.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x).sum()
+        g = autograd.grad(y, [x], create_graph=True)[0]
+        for _ in range(order - 2):
+            g = autograd.grad(g.sum(), [x], create_graph=True)[0]
+        s = g.sum()
+    return autograd.grad(s, [x])[0] if order > 1 else g
+
+
+@pytest.mark.parametrize("case", ["cube", "sin", "exp", "log", "sigmoid"])
+def test_second_order(case):
+    x = onp.array([0.5, 1.0, 1.5], "float32")
+    fns = {
+        "cube": (lambda a: a ** 3, lambda v: 6 * v),
+        "sin": (np.sin, lambda v: -onp.sin(v)),
+        "exp": (np.exp, onp.exp),
+        "log": (np.log, lambda v: -1.0 / v ** 2),
+        "sigmoid": (lambda a: 1 / (1 + np.exp(-a)),
+                    lambda v: (lambda s: s * (1 - s) * (1 - 2 * s))(
+                        1 / (1 + onp.exp(-v)))),
+    }
+    fn, d2 = fns[case]
+    got = _nth_grad(fn, x, 2)
+    assert_almost_equal(got, d2(x), rtol=1e-3, atol=1e-4)
+
+
+def test_third_order():
+    x = onp.array([1.0, 2.0], "float32")
+    got = _nth_grad(lambda a: a ** 4, x, 3)
+    assert_almost_equal(got, 24 * x, rtol=1e-3, atol=1e-3)
+    got = _nth_grad(np.sin, x, 3)
+    assert_almost_equal(got, -onp.cos(x), rtol=1e-3, atol=1e-4)
+
+
+def test_grad_of_grad_multivar():
+    # f = (x*y).sum(); dx = y, dy = x; d/dy of dx.sum() = 1
+    x = np.array([1.0, 2.0])
+    y = np.array([3.0, 4.0])
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        f = (x * y * y).sum()
+        gx = autograd.grad(f, [x], create_graph=True)[0]  # y^2
+        s = gx.sum()
+    gy = autograd.grad(s, [y])[0]  # 2y
+    assert_almost_equal(gy, 2 * y.asnumpy())
+
+
+def test_first_order_create_graph_matches_plain():
+    x = np.array([0.3, 0.7])
+    x.attach_grad()
+    with autograd.record():
+        y = (np.exp(x) * x).sum()
+        g_cg = autograd.grad(y, [x], create_graph=True)[0]
+    x2 = np.array([0.3, 0.7])
+    x2.attach_grad()
+    with autograd.record():
+        y2 = (np.exp(x2) * x2).sum()
+    g_plain = autograd.grad(y2, [x2])[0]
+    assert_almost_equal(g_cg, g_plain.asnumpy(), rtol=1e-5, atol=1e-6)

@@ -32,6 +32,8 @@ def _free_port():
 
 
 def launch_local(n, command, env_extra=None):
+    import time
+
     port = _free_port()
     procs = []
     for rank in range(n):
@@ -41,10 +43,23 @@ def launch_local(n, command, env_extra=None):
         env["MXTPU_DIST_NPROC"] = str(n)
         env["MXTPU_DIST_RANK"] = str(rank)
         procs.append(subprocess.Popen(command, env=env))
+    # poll all workers: one crashing must kill the siblings immediately,
+    # or survivors block inside jax.distributed.initialize for minutes
     rc = 0
+    alive = list(procs)
+    while alive:
+        time.sleep(0.2)
+        for p in list(alive):
+            ret = p.poll()
+            if ret is None:
+                continue
+            alive.remove(p)
+            if ret != 0 and rc == 0:
+                rc = ret
+                for q in alive:
+                    q.terminate()
     for p in procs:
         p.wait()
-        rc = rc or p.returncode
     return rc
 
 
