@@ -288,6 +288,11 @@ def _walk(heads, head_grads, create_graph=False):
             if not isinstance(in_cots, tuple):
                 in_cots = (in_cots,)
         else:
+            if node.vjp is None:
+                raise MXNetError(
+                    "the computation graph was already freed by a previous "
+                    "backward; pass retain_graph=True to backward/grad if "
+                    "you need to differentiate it again")
             full = tuple(
                 cots.get(i, _zero_cotangent(shape, dtype))
                 for i, (shape, dtype) in enumerate(node.out_avals)
@@ -301,7 +306,7 @@ def _walk(heads, head_grads, create_graph=False):
                 nodes[id(info.node)] = info.node
                 heapq.heappush(heap, (-info.node.seq, id(info.node)))
             _sow(info, cot)
-    return var_refs, var_cots
+    return var_refs, var_cots, nodes
 
 
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
@@ -311,7 +316,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     (imperative.cc:387).
     """
     heads, head_grads = _normalize_heads(heads, head_grads)
-    var_refs, var_cots = _walk(heads, head_grads)
+    var_refs, var_cots, nodes = _walk(heads, head_grads)
     from .ndarray.ndarray import NDArray
 
     for vid, cot in var_cots.items():
@@ -323,6 +328,14 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             var._grad._set_data(var._grad._data + cot)
         else:
             var._grad._set_data(cot.astype(var._grad.dtype))
+    if not retain_graph:
+        # release consumed tape state: the vjp closures pin residuals and
+        # node.inputs pin every operand — a non-retained backward is the
+        # tape's end of life (reference: grad graph freed after execution)
+        for node in nodes.values():
+            node.vjp = None
+            node.fn = None
+            node.inputs = ()
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
@@ -343,9 +356,14 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     heads, head_grads = _normalize_heads(heads, head_grads)
     if create_graph:
         with _scope(recording=True, training=train_mode):
-            _, var_cots = _walk(heads, head_grads, create_graph=True)
+            _, var_cots, _ = _walk(heads, head_grads, create_graph=True)
     else:
-        _, var_cots = _walk(heads, head_grads)
+        _, var_cots, nodes = _walk(heads, head_grads)
+        if not retain_graph:
+            for node in nodes.values():
+                node.vjp = None
+                node.fn = None
+                node.inputs = ()
     outs = []
     for v in var_list:
         cot = var_cots.get(id(v))

@@ -109,6 +109,10 @@ def quantize_net(net, quantized_dtype="int8", exclude_layers=None):
     exclude = set(exclude_layers or [])
 
     def _convert(block, prefix=""):
+        # any rewired block's compiled graphs are stale — drop them so the
+        # next call retraces through the quantized layers
+        if hasattr(block, "_cached"):
+            block._cached = {}
         for name, child in list(block._children.items()):
             path = prefix + name
             if isinstance(child, Dense) and path not in exclude and \

@@ -427,6 +427,13 @@ class PrefetchingIter(DataIter):
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30.0)
+            if self._thread.is_alive():
+                # the worker is still inside next(self._iter): re-entering
+                # the iterator now would have two threads driving it —
+                # fail loudly instead of corrupting state
+                raise MXNetError(
+                    "PrefetchingIter.reset: worker still busy after 30s; "
+                    "the wrapped iterator is blocked — cannot safely reset")
         self._iter.reset()
         self._start_worker()
 

@@ -230,6 +230,28 @@ class Dist_Device_Sync(Dist_Sync):
         super().__init__("dist_device_sync")
 
 
+@KVStoreBase.register
+class Horovod(Dist_Sync):
+    """API-parity backend (reference: python/mxnet/kvstore/horovod.py):
+    allreduce semantics ride the same XLA collectives as dist_sync."""
+
+    def __init__(self):
+        super().__init__("horovod")
+
+    def broadcast_parameters(self, params, root_rank=0):
+        for key, value in params.items():
+            self.broadcast(key, value, value)  # in-place broadcast
+
+
+@KVStoreBase.register
+class Byteps(Dist_Sync):
+    """API-parity backend (reference: python/mxnet/kvstore/byteps.py):
+    push-pull semantics over XLA collectives."""
+
+    def __init__(self):
+        super().__init__("byteps")
+
+
 _dist_initialized = False
 
 
@@ -285,7 +307,7 @@ def create(name="local") -> KVStoreBase:
     aliases = {"local": "local", "device": "device", "nccl": "nccl",
                "dist_sync": "dist_sync", "dist_device_sync":
                "dist_device_sync", "dist": "dist_sync",
-               "horovod": "dist_sync", "byteps": "dist_sync"}
+               "horovod": "horovod", "byteps": "byteps"}
     if name not in aliases:
         raise MXNetError(f"unknown kvstore type {name!r}")
     return KVStoreBase.get_kvstore_class(aliases[name])()

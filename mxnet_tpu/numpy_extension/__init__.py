@@ -303,5 +303,13 @@ def slice_like(data, shape_like, axes=None):
     return d[tuple(key)]
 
 
+def custom(*inputs, op_type, **kwargs):
+    """Invoke a registered python custom op (reference: npx.custom /
+    nd.Custom over src/operator/custom)."""
+    from ..operator import custom as _custom
+
+    return _custom(*[_nd(x) for x in inputs], op_type=op_type, **kwargs)
+
+
 # control flow lowered to lax.scan/while/cond lives in .control_flow
 from .control_flow import foreach, while_loop, cond  # noqa: E402,F401

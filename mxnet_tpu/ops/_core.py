@@ -200,6 +200,7 @@ register("flip", lambda axis=None: (lambda x: jnp.flip(x, axis)))
 register("roll", lambda shift=0, axis=None: (lambda x: jnp.roll(x, shift, axis)))
 register("rot90", lambda k=1, axes=(0, 1): (lambda x: jnp.rot90(x, k, axes)))
 register("astype", lambda dtype="float32": (lambda x: x.astype(dtype)))
+register("flatten", lambda **a: (lambda x: jnp.reshape(x, (x.shape[0], -1))))
 register("clip", lambda a_min=None, a_max=None:
          (lambda x: jnp.clip(x, a_min, a_max)))
 register("round", lambda decimals=0: (lambda x: jnp.round(x, decimals)))

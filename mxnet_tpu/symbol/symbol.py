@@ -137,6 +137,45 @@ class Symbol:
     def __neg__(self):
         return Symbol.apply_op("negative", self)
 
+    # -- binding (reference: symbol.py _bind:1795 over the Executor shim) ---
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             **kwargs):
+        from ..executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    _bind = bind
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        """Allocate zeroed args from shapes and bind (reference:
+        simple_bind)."""
+        import jax.numpy as jnp
+
+        from ..executor import Executor
+        from ..ndarray.ndarray import NDArray
+
+        args = {}
+        grads = {}
+        for name in self.list_arguments():
+            if name not in shapes:
+                raise MXNetError(f"simple_bind: missing shape for {name!r}")
+            args[name] = NDArray(jnp.zeros(tuple(shapes[name]), jnp.float32))
+            grads[name] = NDArray(jnp.zeros(tuple(shapes[name]),
+                                            jnp.float32))
+        return Executor(self, ctx, args,
+                        grads if grad_req != "null" else None, grad_req)
+
+    def eval(self, ctx=None, **kwargs):
+        """One-shot evaluation with named inputs (reference: Symbol.eval)."""
+        ex = self.bind(ctx, args=kwargs)
+        return ex.forward()
+
+    def optimize_for(self, backend, *args, **kwargs):
+        """Run a registered subgraph-pass backend over this symbol."""
+        from .. import subgraph
+
+        return subgraph.apply_passes(self, backend)
+
     # -- introspection ------------------------------------------------------
     def list_arguments(self):
         return [n.name for n in topo_sort(self._entries) if n.is_var]

@@ -107,6 +107,59 @@ def check_numeric_gradient(fn, inputs, eps=1e-2, rtol=3e-2, atol=2e-2):
                 f"analytic={analytic[i]}\nnumeric={num}")
 
 
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-4, atol=1e-5):
+    """Bind a symbol to inputs and compare outputs (reference: :1193)."""
+    names = sym.list_arguments()
+    args = {n: (x if isinstance(x, NDArray) else NDArray(onp.asarray(x)))
+            for n, x in zip(names, inputs)}
+    outs = sym.bind(args=args).forward()
+    for got, want in zip(outs, expected):
+        assert_almost_equal(got, want, rtol=rtol, atol=atol)
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected_grads,
+                            rtol=1e-4, atol=1e-5):
+    """Bind with grads, run fwd+bwd, compare input grads (reference: :1193)."""
+    import jax.numpy as jnp
+
+    names = sym.list_arguments()
+    args = {n: (x if isinstance(x, NDArray) else NDArray(onp.asarray(x)))
+            for n, x in zip(names, inputs)}
+    grads = {n: NDArray(jnp.zeros(a.shape, a.dtype))
+             for n, a in args.items()}
+    ex = sym.bind(args=args, args_grad=grads)
+    ex.forward(is_train=True)
+    ex.backward([g if isinstance(g, NDArray) else NDArray(onp.asarray(g))
+                 for g in out_grads])
+    for n, want in zip(names, expected_grads):
+        if want is None:
+            continue
+        assert_almost_equal(grads[n], want, rtol=rtol, atol=atol)
+
+
+def check_consistency(fn, inputs, rtol=1e-4, atol=1e-5):
+    """Run ``fn`` on the accelerator and on CPU and compare — the TPU analog
+    of the reference's cross-context oracle (:1490)."""
+    import jax
+
+    from .context import cpu, tpu, num_tpus
+
+    out_dev = fn([x if isinstance(x, NDArray) else NDArray(onp.asarray(x))
+                  for x in inputs])
+    if num_tpus() == 0:
+        return out_dev  # single platform: nothing to cross-check
+    cpu_inputs = [(x if isinstance(x, NDArray)
+                   else NDArray(onp.asarray(x))).as_in_ctx(cpu())
+                  for x in inputs]
+    out_cpu = fn(cpu_inputs)
+    a = out_dev if isinstance(out_dev, (list, tuple)) else [out_dev]
+    b = out_cpu if isinstance(out_cpu, (list, tuple)) else [out_cpu]
+    for x, y in zip(a, b):
+        assert_almost_equal(x, y, rtol=rtol, atol=atol,
+                            names=("device", "cpu"))
+    return out_dev
+
+
 @contextlib.contextmanager
 def environment(key, value):
     """Temporarily set an env var (reference: :2358)."""

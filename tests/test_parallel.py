@@ -119,3 +119,31 @@ def test_tensor_parallel_spec():
     l0 = float(learner.step(x, y))
     l1 = float(learner.step(x, y))
     assert l1 < l0
+
+
+def test_learner_remat_matches_plain():
+    """jax.checkpoint path must be numerically identical (same math)."""
+    _need_devices()
+    onp.random.seed(1)
+    W = onp.random.randn(4, 6).astype("float32") * 0.1
+
+    def build():
+        net = nn.Dense(4, in_units=6, use_bias=False)
+        net.initialize()
+        net.weight.set_data(np.array(W))
+        return net
+
+    x = mx.np.random.uniform(size=(8, 6))
+    y = mx.np.random.uniform(size=(8, 4))
+    loss_fn = gluon.loss.L2Loss()
+    mesh = parallel.make_mesh({"dp": 8})
+    n1, n2 = build(), build()
+    l1 = parallel.Learner(n1, loss_fn, mx.optimizer.SGD(learning_rate=0.1),
+                          mesh=mesh)
+    l2 = parallel.Learner(n2, loss_fn, mx.optimizer.SGD(learning_rate=0.1),
+                          mesh=mesh, remat=True)
+    a = float(l1.step(x, y))
+    b = float(l2.step(x, y))
+    assert abs(a - b) < 1e-6
+    assert_almost_equal(n1.weight.data(), n2.weight.data(), rtol=1e-5,
+                        atol=1e-6)

@@ -1,0 +1,124 @@
+"""Symbol API + Executor binding (reference: tests/python/unittest/
+test_symbol.py, test_executor.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.symbol as sym
+from mxnet_tpu import np
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_symbolic_backward,
+                                  check_symbolic_forward)
+
+
+def test_symbolic_composition():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = sym.matmul(a, b)
+    d = sym.exp(c) + a
+    assert set(d.list_arguments()) == {"a", "b"}
+
+
+def test_symbol_namespace_ops():
+    a = sym.var("a")
+    out = sym.softmax(sym.relu(a), axis=-1)
+    assert out.list_arguments() == ["a"]
+    # legacy CamelCase aliases
+    w = sym.var("w")
+    fc = sym.FullyConnected(a, w, num_hidden=4, no_bias=True)
+    assert set(fc.list_arguments()) == {"a", "w"}
+
+
+def test_bind_forward():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = sym.matmul(a, b)
+    av = onp.random.randn(2, 3).astype("float32")
+    bv = onp.random.randn(3, 4).astype("float32")
+    ex = c.bind(args={"a": np.array(av), "b": np.array(bv)})
+    out = ex.forward()
+    assert_almost_equal(out[0], av @ bv, rtol=1e-4, atol=1e-4)
+    # forward with replaced input
+    av2 = onp.random.randn(2, 3).astype("float32")
+    out = ex.forward(a=np.array(av2))
+    assert_almost_equal(out[0], av2 @ bv, rtol=1e-4, atol=1e-4)
+
+
+def test_bind_backward():
+    a = sym.var("a")
+    out = sym.sum(sym.multiply(a, a))
+    av = onp.array([1.0, 2.0, 3.0], "float32")
+    check_symbolic_forward(out, [av], [onp.array(14.0)])
+    check_symbolic_backward(out, [av], [onp.array(1.0)], [2 * av])
+
+
+def test_simple_bind():
+    a = sym.var("a")
+    b = sym.var("b")
+    ex = (a + b).simple_bind(a=(2, 2), b=(2, 2))
+    out = ex.forward()
+    assert out[0].shape == (2, 2)
+    assert ex.arg_dict["a"].shape == (2, 2)
+
+
+def test_bind_errors():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b
+    with pytest.raises(MXNetError):
+        c.bind(args={"a": np.ones((2,))})  # missing b
+    with pytest.raises(MXNetError):
+        c.simple_bind(a=(2,))  # missing shape for b
+
+
+def test_group_and_json():
+    a = sym.var("a")
+    g = sym.Group([a * 2, a + 1])
+    ex = g.bind(args={"a": np.array([1.0, 2.0])})
+    o1, o2 = ex.forward()
+    assert o1.asnumpy().tolist() == [2.0, 4.0]
+    assert o2.asnumpy().tolist() == [2.0, 3.0]
+    js = g.tojson()
+    g2 = sym.fromjson(js)
+    assert len(g2) == 2
+
+
+def test_infer_shape_api():
+    a = sym.var("a")
+    w = sym.var("w")
+    out = sym.FullyConnected(a, w, num_hidden=8, no_bias=True)
+    _, out_shapes, _ = out.infer_shape(a=(4, 16), w=(8, 16))
+    assert out_shapes[0] == (4, 8)
+
+
+def test_kvstore_parity_backends():
+    from mxnet_tpu import kvstore
+
+    for name in ("horovod", "byteps"):
+        kv = kvstore.create(name)
+        assert kv.num_workers >= 1
+    kv = kvstore.create("horovod")
+    p = {"w": np.array([1.0, 2.0])}
+    kv.broadcast_parameters(p)
+
+
+def test_npx_custom():
+    from mxnet_tpu import operator as op_mod, npx
+
+    @op_mod.register("npx_double")
+    class DoubleProp(op_mod.CustomOpProp):
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            class Double(op_mod.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                np.array(in_data[0].asnumpy() * 2))
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_grad[0])
+
+            return Double()
+
+    out = npx.custom(np.array([1.0, 2.0]), op_type="npx_double")
+    assert out.asnumpy().tolist() == [2.0, 4.0]
