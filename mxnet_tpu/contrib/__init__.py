@@ -2,5 +2,6 @@
 from . import text
 from . import quantization
 from . import onnx
+from . import tensorboard
 
-__all__ = ["text", "quantization", "onnx"]
+__all__ = ["text", "quantization", "onnx", "tensorboard"]

@@ -1,0 +1,68 @@
+"""TensorBoard bridge (reference: python/mxnet/contrib/tensorboard.py —
+LogMetricsCallback over a SummaryWriter).
+
+Two sinks: a real SummaryWriter when tensorboardX/torch.utils.tensorboard is
+importable, else a JSONL event file per run (one {"step", "tag", "value"}
+line per scalar) that tensorboard-less tooling can consume. XLA-level traces
+come from mx.profiler (xplane), which TensorBoard's profile plugin reads.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+
+class _JsonlWriter:
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        self._f = open(os.path.join(
+            logdir, f"events.{int(time.time())}.jsonl"), "a")
+
+    def add_scalar(self, tag, value, global_step=None):
+        self._f.write(json.dumps({"tag": tag, "value": float(value),
+                                  "step": global_step,
+                                  "wall_time": time.time()}) + "\n")
+        self._f.flush()
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def SummaryWriter(logdir="./logs", **kwargs):
+    """Best available scalar writer for ``logdir``."""
+    try:
+        from torch.utils.tensorboard import SummaryWriter as TorchWriter
+
+        return TorchWriter(log_dir=logdir, **kwargs)
+    except Exception:  # noqa: BLE001 — torch tb needs tensorboard pkg
+        pass
+    try:
+        from tensorboardX import SummaryWriter as TbxWriter
+
+        return TbxWriter(logdir=logdir, **kwargs)
+    except Exception:  # noqa: BLE001
+        return _JsonlWriter(logdir)
+
+
+class LogMetricsCallback:
+    """Batch-end callback logging EvalMetric values (reference API)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        self.step += 1
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)

@@ -659,6 +659,11 @@ def fft(*a, **kw):  # namespace placeholder; see np.fft module functions below
 
 
 def histogram(a, bins=10, range=None):
+    if isinstance(bins, int):
+        # static bin count: compiled XLA path (traceable, stays on device)
+        h, edges = _op("histogram_bounded", _as_nd(a), bins=bins,
+                       range=tuple(range) if range else None)
+        return h, edges
     h, edges = _onp.histogram(_as_nd(a).asnumpy(), bins=bins, range=range)
     return NDArray(h), NDArray(edges)
 
@@ -672,3 +677,63 @@ def index_update(a, key, value):
 
 def index_add(a, key, value):
     return _indexing.index_add(_as_nd(a), key, value)
+
+
+# -- extra surface ----------------------------------------------------------
+signbit = _def_unary("signbit")
+positive = _def_unary("positive")
+deg2rad = _def_unary("deg2rad")
+rad2deg = _def_unary("rad2deg")
+exp2 = _def_unary("exp2")
+i0 = _def_unary("i0")
+sinc = _def_unary("sinc")
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    return _op("nan_to_num", _as_nd(x), nan=nan, posinf=posinf,
+               neginf=neginf)
+heaviside = _def_binary("heaviside")
+float_power = _def_binary("float_power")
+
+
+def divmod(x1, x2):
+    a, b = _both_nd(x1, x2)
+    return _op("true_divmod", a, b)
+
+
+def digitize(x, bins, right=False):
+    return _op("digitize", _as_nd(x), _as_nd(bins), right=right)
+
+
+def corrcoef(x):
+    return _op("corrcoef", _as_nd(x))
+
+
+def cov(m):
+    return _op("cov", _as_nd(m))
+
+
+def append(arr, values, axis=None):
+    a = _as_nd(arr)
+    v = values if isinstance(values, NDArray) else array(values)
+    if axis is None:
+        return concatenate([a.reshape((-1,)), v.reshape((-1,))], axis=0)
+    return concatenate([a, v], axis=axis)
+
+
+def delete(arr, obj, axis=None):
+    host = _as_nd(arr).asnumpy()
+    return NDArray(_onp.delete(host, obj if not isinstance(obj, NDArray)
+                               else obj.asnumpy(), axis=axis))
+
+
+def insert(arr, obj, values, axis=None):
+    host = _as_nd(arr).asnumpy()
+    vals = values.asnumpy() if isinstance(values, NDArray) else values
+    return NDArray(_onp.insert(host, obj, vals, axis=axis))
+
+
+def trim_zeros(filt, trim="fb"):
+    return NDArray(_onp.trim_zeros(_as_nd(filt).asnumpy(), trim))
+
+
+def count_nonzero(a, axis=None):
+    return sum(not_equal(_as_nd(a), 0).astype("int32"), axis=axis)

@@ -315,3 +315,28 @@ register("fft", lambda n=None, axis=-1: (lambda x: jnp.fft.fft(x, n, axis)))
 register("ifft", lambda n=None, axis=-1: (lambda x: jnp.fft.ifft(x, n, axis)))
 register("rfft", lambda n=None, axis=-1: (lambda x: jnp.fft.rfft(x, n, axis)))
 register("irfft", lambda n=None, axis=-1: (lambda x: jnp.fft.irfft(x, n, axis)))
+
+# extra numpy-parity elementwise ops
+_EXTRA_UNARY = {
+    "signbit": jnp.signbit,
+    "positive": jnp.positive,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+    "exp2": jnp.exp2,
+    "i0": jnp.i0,
+    "sinc": jnp.sinc,
+}
+for _name, _fn in _EXTRA_UNARY.items():
+    register(_name, (lambda f: (lambda **a: f))(_fn))
+register("nan_to_num", lambda nan=0.0, posinf=None, neginf=None:
+         (lambda x: jnp.nan_to_num(x, nan=nan, posinf=posinf,
+                                   neginf=neginf)))
+register("heaviside", lambda **a: jnp.heaviside)
+register("float_power", lambda **a: jnp.float_power)
+register("true_divmod", lambda **a: (lambda a_, b: tuple(jnp.divmod(a_, b))))
+register("digitize", lambda right=False:
+         (lambda x, bins: jnp.digitize(x, bins, right=right)))
+register("histogram_bounded", lambda bins=10, range=None:
+         (lambda x: tuple(jnp.histogram(x, bins=bins, range=range))))
+register("corrcoef", lambda **a: jnp.corrcoef)
+register("cov", lambda **a: jnp.cov)

@@ -1,10 +1,10 @@
 """Optimizer package (reference: python/mxnet/optimizer/)."""
 from .optimizer import (Optimizer, SGD, Adam, AdamW, NAG, RMSProp, AdaGrad,
                         AdaDelta, Adamax, Nadam, Ftrl, FTML, Signum, LAMB,
-                        LARS, AdaBelief, SGLD, DCASGD, create, register)
+                        LARS, LANS, AdaBelief, SGLD, DCASGD, create, register)
 from .updater import Updater, get_updater
 
 __all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "AdaGrad",
            "AdaDelta", "Adamax", "Nadam", "Ftrl", "FTML", "Signum", "LAMB",
-           "LARS", "AdaBelief", "SGLD", "DCASGD", "create", "register",
+           "LARS", "LANS", "AdaBelief", "SGLD", "DCASGD", "create", "register",
            "Updater", "get_updater"]

@@ -587,6 +587,44 @@ class LARS(Optimizer):
 
 
 @register
+class LANS(Optimizer):
+    """Large-batch Adam with normalized step + layer-wise trust ratio
+    (reference: contrib adamw.cc lans_* kernels)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        b1, b2, eps = beta1, beta2, epsilon
+
+        def step(w, m, v, g, lr, wd, t):
+            g = self._pre(g).astype(jnp.float32)
+            g = g / jnp.maximum(jnp.linalg.norm(g), 1e-12)  # normalized grad
+            wf = w.astype(jnp.float32)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            mhat = m / (1 - b1 ** t)
+            vhat = v / (1 - b2 ** t)
+            w_norm = jnp.linalg.norm(wf)
+
+            def trust(update):
+                r = update + wd * wf
+                r_norm = jnp.linalg.norm(r)
+                ratio = jnp.where((w_norm > 0) & (r_norm > 0),
+                                  w_norm / r_norm, 1.0)
+                return ratio * r
+
+            r1 = trust(mhat / (jnp.sqrt(vhat) + eps))
+            r2 = trust(g / (jnp.sqrt(vhat) + eps))
+            upd = b1 * r1 + (1 - b1) * r2
+            return (wf - lr * upd).astype(w.dtype), m, v
+
+        self._step = _jit_step(step, 3)
+
+    create_state = _AdamBase.create_state
+    _apply = _AdamBase._apply
+
+
+@register
 class AdaBelief(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):

@@ -10,7 +10,7 @@ from mxnet_tpu.test_utils import assert_almost_equal
 
 ALL_OPTS = ["sgd", "nag", "adam", "adamw", "adamax", "nadam", "rmsprop",
             "adagrad", "adadelta", "ftrl", "ftml", "signum", "lamb", "lars",
-            "adabelief", "sgld", "dcasgd"]
+            "adabelief", "sgld", "dcasgd", "lans"]
 
 
 def test_sgd_update_math():
@@ -63,7 +63,7 @@ def test_clip_gradient():
 def test_optimizer_minimizes_quadratic(name):
     kwargs = {"learning_rate": 0.05}
     if name in ("adam", "adamw", "adamax", "nadam", "adabelief", "lamb",
-                "ftml"):
+                "ftml", "lans"):
         kwargs["learning_rate"] = 0.1
     if name in ("adagrad", "ftrl"):
         kwargs["learning_rate"] = 0.5
