@@ -82,14 +82,19 @@ def bench_resnet_train():
             "vs_baseline": round(img_s / BASELINE_RESNET_TRAIN, 3)}
 
 
-def bench_bert_pretrain():
-    """BERT-Base MLM+NSP pretraining step, bf16, one chip (config 4)."""
+def bench_bert_pretrain(size="base"):
+    """BERT MLM+NSP pretraining step, bf16, one chip (configs 4 and the
+    BERT-Large north-star metric)."""
     import mxnet_tpu as mx
     from mxnet_tpu import amp, gluon, parallel
     from mxnet_tpu.gluon.model_zoo.bert import bert_base, BERTForPretraining
 
-    B, T, WARMUP, ITERS = 32, 128, 2, 8
-    bert = bert_base(max_length=T, dropout=0.1, dtype="float32")
+    from mxnet_tpu.gluon.model_zoo.bert import bert_large
+
+    B = 32 if size == "base" else 8
+    T, WARMUP, ITERS = 128, 2, 8
+    maker = bert_base if size == "base" else bert_large
+    bert = maker(max_length=T, dropout=0.1, dtype="float32")
     model = BERTForPretraining(bert, vocab_size=30522)
     model.initialize()
     amp.convert_hybrid_block(model, "bfloat16")
@@ -104,7 +109,8 @@ def bench_bert_pretrain():
 
     learner = parallel.Learner(model, pretrain_loss,
                                mx.optimizer.AdamW(learning_rate=1e-4,
-                                                  wd=0.01))
+                                                  wd=0.01),
+                               remat=(size == "large"))
     tokens = mx.np.random.randint(0, 30522, size=(B, T))
     labels = mx.np.concatenate([
         mx.np.random.randint(0, 30522, size=(B, T)),
@@ -117,7 +123,7 @@ def bench_bert_pretrain():
     _sync(loss._data)
     dt = time.perf_counter() - t0
     tok_s = B * T * ITERS / dt
-    return {"metric": "bert_base_pretrain_bf16_tokens_per_sec",
+    return {"metric": f"bert_{size}_pretrain_bf16_tokens_per_sec",
             "value": round(tok_s, 1), "unit": "tokens/s",
             "vs_baseline": round(tok_s / BASELINE_BERT_TOKENS, 3)}
 
@@ -125,9 +131,13 @@ def bench_bert_pretrain():
 def main():
     which = (sys.argv[1] if len(sys.argv) > 1 else
              os.environ.get("BENCH", "resnet"))
+    import functools
+
     fn = {"resnet": bench_resnet_infer,
           "resnet_train": bench_resnet_train,
-          "bert_pretrain": bench_bert_pretrain}[which]
+          "bert_pretrain": bench_bert_pretrain,
+          "bert_large_pretrain": functools.partial(bench_bert_pretrain,
+                                                   "large")}[which]
     print(json.dumps(fn()))
 
 

@@ -35,19 +35,24 @@ class _JsonlWriter:
 
 
 def SummaryWriter(logdir="./logs", **kwargs):
-    """Best available scalar writer for ``logdir``."""
+    """Best available scalar writer for ``logdir``.
+
+    Only missing PACKAGES trigger the fallback chain; constructor errors
+    (bad kwargs etc.) propagate so user mistakes are visible.
+    """
     try:
         from torch.utils.tensorboard import SummaryWriter as TorchWriter
-
+    except ImportError:
+        TorchWriter = None
+    if TorchWriter is not None:
         return TorchWriter(log_dir=logdir, **kwargs)
-    except Exception:  # noqa: BLE001 — torch tb needs tensorboard pkg
-        pass
     try:
         from tensorboardX import SummaryWriter as TbxWriter
-
+    except ImportError:
+        TbxWriter = None
+    if TbxWriter is not None:
         return TbxWriter(logdir=logdir, **kwargs)
-    except Exception:  # noqa: BLE001
-        return _JsonlWriter(logdir)
+    return _JsonlWriter(logdir)
 
 
 class LogMetricsCallback:

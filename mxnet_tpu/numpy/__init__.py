@@ -660,10 +660,11 @@ def fft(*a, **kw):  # namespace placeholder; see np.fft module functions below
 
 def histogram(a, bins=10, range=None):
     if isinstance(bins, int):
-        # static bin count: compiled XLA path (traceable, stays on device)
+        # static bin count: compiled XLA path (traceable, stays on device);
+        # counts cast to int32 to match the host path's integer semantics
         h, edges = _op("histogram_bounded", _as_nd(a), bins=bins,
                        range=tuple(range) if range else None)
-        return h, edges
+        return h.astype("int32"), edges
     h, edges = _onp.histogram(_as_nd(a).asnumpy(), bins=bins, range=range)
     return NDArray(h), NDArray(edges)
 

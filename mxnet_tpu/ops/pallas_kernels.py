@@ -10,9 +10,10 @@ softmax kernel.
 
 Design:
 - flash attention fwd is a Pallas kernel (online softmax, tiled over KV
-  blocks, accumulation in fp32 VMEM scratch); backward recomputes through the
-  plain XLA path via jax.custom_vjp (memory-heavy but correct; a Pallas bwd
-  kernel is future work).
+  blocks, accumulation in fp32 VMEM scratch); backward is a blockwise
+  recompute (two lax.scans over KV blocks, standard flash-bwd identities) so
+  training memory stays O(T * block) — a hand-written Pallas bwd kernel is a
+  possible further optimization.
 - kernels engage only on the TPU backend with aligned shapes; everywhere else
   the mathematically identical XLA reference path runs, so the CPU test mesh
   exercises the same API.
@@ -182,13 +183,101 @@ def _flash_fwd(q, k, v, scale, causal):
     return _flash_attention_impl(q, k, v, scale, causal), (q, k, v)
 
 
+_BWD_BLOCK = 512
+
+
+def _attention_bwd_blockwise(q, k, v, g, scale, causal):
+    """Memory-capped attention backward: recompute scores blockwise over KV.
+
+    Standard flash-attention backward structure without a hand-written
+    kernel: two passes of lax.scan over KV blocks keep peak memory at
+    O(T * block) instead of O(T^2), so long-context training fits in HBM.
+    XLA fuses each block's matmul chain onto the MXU.
+    """
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    # largest divisor of tk up to the cap keeps the memory bound for ANY
+    # block-unfriendly length; only tiny/pathological divisors (where the
+    # scan would degenerate) fall back to the dense vjp — and those lengths
+    # are small enough that O(T^2) is not a memory problem
+    blk = max((d_ for d_ in range(1, min(_BWD_BLOCK, tk) + 1)
+               if tk % d_ == 0), default=tk)
+    if blk < 16 and tk > 4096:
+        blk = 1  # prime-ish huge tk: still capped, just slower
+    elif blk < 16:
+        _, vjp = jax.vjp(lambda q_, k_, v_:
+                         _attention_reference(q_, k_, v_, scale, causal),
+                         q, k, v)
+        return vjp(g)
+    nblk = tk // blk
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    kb = k.reshape(b, h, nblk, blk, d).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(b, h, nblk, blk, d).transpose(2, 0, 1, 3, 4)
+
+    def mask_for(idx):
+        if not causal:
+            return None
+        qi = jnp.arange(tq)[:, None] + (tk - tq)
+        ki = idx * blk + jnp.arange(blk)[None, :]
+        return (qi >= ki)[None, None]
+
+    # pass 1: softmax stats (row max m, denominator l) + output recompute
+    def stats_step(carry, inputs):
+        m_prev, l_prev, acc = carry
+        kb_i, vb_i, idx = inputs
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb_i.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        msk = mask_for(idx)
+        if msk is not None:
+            s = jnp.where(msk, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vb_i.astype(jnp.float32))
+        return (m_new, l_new, acc), None
+
+    m0 = jnp.full((b, h, tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
+    a0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    (m, l, acc), _ = lax.scan(stats_step, (m0, l0, a0),
+                              (kb, vb, jnp.arange(nblk)))
+    out = acc / jnp.maximum(l, 1e-30)
+    # delta_i = sum_d g_i * o_i (standard flash bwd identity)
+    delta = jnp.sum(gf * out, axis=-1, keepdims=True)
+
+    # pass 2: gradients per KV block
+    def grad_step(dq_acc, inputs):
+        kb_i, vb_i, idx = inputs
+        kf = kb_i.astype(jnp.float32)
+        vf = vb_i.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf,
+                       preferred_element_type=jnp.float32) * scale
+        msk = mask_for(idx)
+        if msk is not None:
+            s = jnp.where(msk, s, _NEG_INF)
+        p = jnp.exp(s - m) / jnp.maximum(l, 1e-30)  # (b,h,q,blk)
+        dv_i = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+        ds = p * (dp - delta) * scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk_i = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq_acc, (dk_i, dv_i)
+
+    dq, (dk_b, dv_b) = lax.scan(grad_step, jnp.zeros_like(qf),
+                                (kb, vb, jnp.arange(nblk)))
+    dk = dk_b.transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
+    dv = dv_b.transpose(1, 2, 0, 3, 4).reshape(b, h, tk, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 def _flash_bwd(scale, causal, res, g):
     q, k, v = res
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
-    _, vjp = jax.vjp(lambda q_, k_, v_:
-                     _attention_reference(q_, k_, v_, s, causal), q, k, v)
-    return vjp(g)
+    return _attention_bwd_blockwise(q, k, v, g, s, causal)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
