@@ -42,13 +42,13 @@ def SummaryWriter(logdir="./logs", **kwargs):
     """
     try:
         from torch.utils.tensorboard import SummaryWriter as TorchWriter
-    except ImportError:
+    except (ImportError, OSError):  # broken torch installs raise OSError
         TorchWriter = None
     if TorchWriter is not None:
         return TorchWriter(log_dir=logdir, **kwargs)
     try:
         from tensorboardX import SummaryWriter as TbxWriter
-    except ImportError:
+    except (ImportError, OSError):
         TbxWriter = None
     if TbxWriter is not None:
         return TbxWriter(logdir=logdir, **kwargs)

@@ -666,7 +666,7 @@ def histogram(a, bins=10, range=None):
                        range=tuple(range) if range else None)
         return h.astype("int32"), edges
     h, edges = _onp.histogram(_as_nd(a).asnumpy(), bins=bins, range=range)
-    return NDArray(h), NDArray(edges)
+    return NDArray(h.astype(_onp.int32)), NDArray(edges)
 
 
 def index_update(a, key, value):

@@ -1,0 +1,94 @@
+"""Expert parallelism: mixture-of-experts with experts sharded over 'ep'.
+
+Not present in the reference (SURVEY §2.2: EP absent). TPU-native design:
+expert weights are stacked on a leading expert axis sharded over ``ep``;
+tokens are top-1 routed, exchanged between devices with ``lax.all_to_all``
+(ICI), processed by the local experts, and returned. Capacity-factor dropping
+keeps shapes static for XLA.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["moe_apply", "moe_sharded"]
+
+
+def moe_apply(x, gate_w, expert_w1, expert_w2, axis_name="ep", capacity=None):
+    """Per-shard MoE body (call inside shard_map).
+
+    x: (T_local, D) local token shard; gate_w: (D, E_total) replicated;
+    expert_w1: (E_local, D, H), expert_w2: (E_local, H, D) — local experts.
+    Top-1 routing with per-expert capacity; overflow tokens pass through.
+    """
+    n_dev = lax.psum(1, axis_name)
+    t_local, d = x.shape
+    e_local = expert_w1.shape[0]
+    e_total = e_local * n_dev
+    cap = capacity or max(1, (t_local // e_total) * 2)
+
+    logits = x @ gate_w  # (T, E_total)
+    expert_id = jnp.argmax(logits, axis=-1)  # (T,)
+    gate = jax.nn.softmax(logits, axis=-1)
+    gate_val = jnp.take_along_axis(gate, expert_id[:, None], axis=1)[:, 0]
+
+    # slot each token into its expert's capacity buffer (static shapes)
+    onehot = jax.nn.one_hot(expert_id, e_total, dtype=jnp.int32)  # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per token
+    slot = jnp.sum(pos, axis=-1) - 1  # (T,)
+    keep = slot < cap
+    # dispatch buffer: (E_total, cap, D)
+    dispatch = jnp.zeros((e_total, cap, d), x.dtype)
+    tok_idx = jnp.where(keep, expert_id, 0)
+    slot_idx = jnp.where(keep, slot, 0)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    dispatch = dispatch.at[tok_idx, slot_idx].add(contrib)
+
+    # all_to_all: every device sends each expert-group to its owner
+    # (E_total, cap, D) -> split E_total over devices -> concat on a new axis
+    shaped = dispatch.reshape(n_dev, e_local, cap, d)
+    recv = lax.all_to_all(shaped, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)  # (n_dev, e_local, cap, d)
+    recv = recv.transpose(1, 0, 2, 3).reshape(e_local, n_dev * cap, d)
+
+    # local expert MLPs (batched over local experts)
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", recv, expert_w1))
+    y = jnp.einsum("ech,ehd->ecd", h, expert_w2)
+
+    # route results back to the source devices
+    y = y.reshape(e_local, n_dev, cap, d).transpose(1, 0, 2, 3)
+    back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+    back = back.reshape(e_total, cap, d)
+
+    out = back[tok_idx, slot_idx]  # (T, D)
+    out = jnp.where(keep[:, None], out * gate_val[:, None], x)  # overflow: pass-through
+    return out
+
+
+def moe_sharded(x, gate_w, expert_w1, expert_w2, mesh, axis="ep",
+                capacity=None):
+    """User-facing MoE layer over a mesh: tokens sharded over ``ep``,
+    experts sharded over ``ep``, gate replicated."""
+    from jax import shard_map
+
+    from ..ndarray.ndarray import NDArray
+
+    if axis not in mesh.shape:
+        raise MXNetError(f"mesh has no axis {axis!r}")
+    unwrap = lambda a: a._data if isinstance(a, NDArray) else a  # noqa: E731
+    xd, gw, w1, w2 = map(unwrap, (x, gate_w, expert_w1, expert_w2))
+    fn = shard_map(
+        functools.partial(moe_apply, axis_name=axis, capacity=capacity),
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(axis)),
+        out_specs=P(axis),
+    )
+    out = jax.jit(fn)(xd, gw, w1, w2)
+    return NDArray(out) if isinstance(x, NDArray) else out

@@ -1,0 +1,120 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a 'pp' axis.
+
+The reference has NO pipeline parallelism (SURVEY §2.2: PP absent). TPU-native
+design for homogeneous stages (the transformer/MLP-stack case): per-stage
+parameters are STACKED on a leading axis sharded over ``pp``; inside
+shard_map each device holds its stage's slice and activations flow around the
+ring via ``lax.ppermute`` while microbatches stream through — the classic
+GPipe schedule (S + M - 1 ticks for S stages, M microbatches). Everything is
+jax-native and differentiable, so fwd+bwd+update compiles to one SPMD program
+with XLA overlapping the ICI sends with stage compute.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply", "pipeline_sharded"]
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
+    """Run the pipeline body on ONE device's shard (call inside shard_map).
+
+    stage_fn(params_slice, x) -> activation of the same shape class.
+    stage_params: pytree whose leaves have a leading axis of LOCAL length 1
+        (the global leading axis is the stage count, sharded over pp).
+    microbatches: (M, mb, ...) — full microbatch stream (replicated).
+
+    Returns (M, mb, ...) outputs as produced by the LAST stage (zeros on the
+    other shards; the caller selects/reduces stage S-1's copy).
+    """
+    n_stage = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    params_local = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    right = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    mb_shape = microbatches.shape[1:]
+    total = m + n_stage - 1
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 injects microbatch t (when within range); others use the
+        # activation that arrived from the left neighbor
+        inject = jnp.where(t < m, t, m - 1)
+        x_in = jnp.where(my == 0, microbatches[inject], buf)
+        active = jnp.logical_and(my <= t, t - my < m)
+        y = stage_fn(params_local, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # last stage writes its finished microbatch t - (S-1)
+        out_idx = jnp.clip(t - (n_stage - 1), 0, m - 1)
+        is_out = jnp.logical_and(my == n_stage - 1, active)
+        outs = outs.at[out_idx].set(
+            jnp.where(is_out, y, outs[out_idx]))
+        # rotate activations one stage to the right
+        buf = lax.ppermute(y, axis_name, right)
+        return (buf, outs), None
+
+    buf0 = jnp.zeros(mb_shape, microbatches.dtype)
+    outs0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
+    if hasattr(lax, "pcast"):
+        # literal-zero carries are axis-invariant; the loop makes them vary
+        buf0, outs0 = (lax.pcast(z, (axis_name,), to="varying")
+                       for z in (buf0, outs0))
+    (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(total))
+    # broadcast the last stage's outputs to every shard so the caller gets
+    # identical values regardless of which shard it reads
+    outs = lax.psum(
+        jnp.where(my == n_stage - 1, outs, jnp.zeros_like(outs)), axis_name)
+    return outs
+
+
+def pipeline_sharded(stage_fn, stacked_params, x, mesh, num_microbatches,
+                     axis="pp"):
+    """User-facing GPipe runner.
+
+    stacked_params: pytree with leading STAGE axis (length = mesh.shape[pp]),
+        will be sharded P('pp') over the mesh.
+    x: (batch, ...) input; split into ``num_microbatches`` along axis 0.
+    Returns the pipeline output with the original batch layout.
+    """
+    from jax import shard_map
+
+    from ..ndarray.ndarray import NDArray
+
+    if axis not in mesh.shape:
+        raise MXNetError(f"mesh has no axis {axis!r}")
+    n_stage = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != n_stage:
+            raise MXNetError(
+                f"stacked stage axis has length {leaf.shape[0]} but the "
+                f"{axis!r} mesh axis has {n_stage} devices — one stage per "
+                "device is required (pipeline_apply uses params[0] locally)")
+    wrap = isinstance(x, NDArray)
+    xd = x._data if wrap else x
+    batch = xd.shape[0]
+    if batch % num_microbatches:
+        raise MXNetError(f"num_microbatches ({num_microbatches}) must divide the batch size ({batch})")
+    mb = batch // num_microbatches
+    xmb = xd.reshape((num_microbatches, mb) + xd.shape[1:])
+    pd = jax.tree_util.tree_map(
+        lambda p: p._data if isinstance(p, NDArray) else p, stacked_params)
+
+    pspec = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), pd)
+    fn = shard_map(
+        functools.partial(pipeline_apply, stage_fn, axis_name=axis),
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(),
+    )
+    out = jax.jit(fn)(pd, xmb)
+    out = out.reshape((batch,) + out.shape[2:])
+    return NDArray(out) if wrap else out
