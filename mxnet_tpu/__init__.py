@@ -53,6 +53,11 @@ from . import library
 from . import subgraph
 from . import contrib
 from . import rtc
+from . import utils
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from .name import NameManager
 from . import visualization
 from . import callback
 from . import model

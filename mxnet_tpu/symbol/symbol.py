@@ -34,15 +34,19 @@ class Literal:
 class SymNode:
     """One graph node: an op application, a variable, or a constant."""
 
-    __slots__ = ("op", "attrs", "inputs", "name", "value", "seq", "nout")
+    __slots__ = ("op", "attrs", "inputs", "name", "value", "seq", "nout",
+                 "attr_dict")
 
     def __init__(self, op=None, attrs=None, inputs=(), name=None, value=None,
-                 nout=1):
+                 nout=1, attr_dict=None):
         self.op = op            # registry.Op, or None for var/const
         self.attrs = attrs or {}
         self.inputs = tuple(inputs)  # entries: (SymNode, out_idx) | Literal
         self.name = name
         self.value = value      # jax.Array for const nodes
+        self.attr_dict = attr_dict or {}  # AttrScope metadata (reference:
+        # symbol attrs readable via attr()/list_attr; consumed by user code
+        # and graph passes)
         self.seq = next(_seq)
         self.nout = nout
 
@@ -102,13 +106,25 @@ class Symbol:
 
     @classmethod
     def apply_op(cls, op_name, *inputs, nout=1, **attrs):
+        from ..attribute import AttrScope
+        from ..name import NameManager
+
         op = get_op(op_name)
         entries = [cls._entry_of(x) for x in inputs]
-        node = SymNode(op=op, attrs=attrs, inputs=entries, nout=nout)
+        node = SymNode(op=op, attrs=attrs, inputs=entries, nout=nout,
+                       name=NameManager.current().get(None, op_name),
+                       attr_dict=AttrScope.current().get())
         return cls([(node, i) for i in range(nout)])
 
     def __getitem__(self, i):
         return Symbol([self._entries[i]])
+
+    def attr(self, key):
+        """Read an AttrScope attribute from this symbol's head node."""
+        return self._entries[0][0].attr_dict.get(key)
+
+    def list_attr(self):
+        return dict(self._entries[0][0].attr_dict)
 
     def __len__(self):
         return len(self._entries)
@@ -300,9 +316,18 @@ def _unjson_attrs(attrs):
     return out
 
 
-def var(name, shape=None, dtype=None, **kw):
-    """Create a free variable symbol (reference: sym.var / sym.Variable)."""
-    return Symbol([(SymNode(name=name), 0)])
+def var(name=None, shape=None, dtype=None, **kw):
+    """Create a free variable symbol (reference: sym.var / sym.Variable).
+
+    AttrScope attributes in effect (plus explicit **kw) attach to the node
+    and are readable via Symbol.attr/list_attr.
+    """
+    from ..attribute import AttrScope
+    from ..name import NameManager
+
+    name = NameManager.current().get(name, "var")
+    attrs = AttrScope.current().get({k: str(v) for k, v in kw.items()})
+    return Symbol([(SymNode(name=name, attr_dict=attrs), 0)])
 
 
 Variable = var

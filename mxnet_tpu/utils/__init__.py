@@ -1,0 +1,83 @@
+"""mxnet_tpu.utils — grab-bag helpers (split/load, download-less data utils).
+
+Reference: python/mxnet/gluon/utils.py (split_and_load, check_sha1, download)
++ python/mxnet/util.py switches re-exported from ..util.
+"""
+from __future__ import annotations
+
+import hashlib
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..util import (is_np_array, is_np_shape, set_np, np_array, np_shape,
+                    use_np, getenv, setenv)
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "is_np_array", "is_np_shape", "set_np", "use_np"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split an array along ``batch_axis`` (reference: gluon/utils.py).
+
+    On TPU the preferred pattern is mesh sharding (parallel.shard_batch), but
+    the explicit split keeps multi-device scripts running.
+    """
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice:
+        raise MXNetError(
+            f"cannot evenly split axis of size {size} into {num_slice} "
+            "slices (pass even_split=False)")
+    step, extra = divmod(size, num_slice)
+    slices = []
+    lo = 0
+    for i in range(num_slice):
+        # distribute the remainder one-per-leading-slice (reference
+        # semantics: balanced load across devices)
+        hi = lo + step + (1 if i < extra else 0)
+        key = [slice(None)] * data.ndim
+        key[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(key)])
+        lo = hi
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and place slices on each ctx (reference: gluon/utils.py)."""
+    if not isinstance(data, NDArray):
+        data = NDArray(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_ctx(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_ctx(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so their joint L2 norm <= max_norm (reference:
+    gluon/utils.py clip_global_norm)."""
+    import math
+
+    # accumulate on device; ONE host sync at the end (hot-path friendly)
+    total = None
+    for arr in arrays:
+        sq = (arr.astype("float32") ** 2).sum()
+        total = sq if total is None else total + sq
+    norm = math.sqrt(float(total))
+    if check_isfinite and not math.isfinite(norm):
+        raise MXNetError("gradient norm is not finite")
+    scale = max_norm / (norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._set_data(arr._data * scale)
+    return norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Reference: gluon/utils.py check_sha1 (no download in zero-egress)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash

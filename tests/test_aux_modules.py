@@ -163,3 +163,62 @@ def test_runtime_features():
     assert feats.is_enabled("XLA")
     assert not feats.is_enabled("CUDA")
     assert len(mx.runtime.feature_list()) > 5
+
+
+def test_utils_split_and_load():
+    from mxnet_tpu import utils
+
+    data = np.array(onp.arange(12).reshape(6, 2).astype("float32"))
+    parts = utils.split_data(data, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    loaded = utils.split_and_load(data, [mx.cpu()])
+    assert loaded[0].shape == (6, 2)
+    with pytest.raises(MXNetError):
+        utils.split_data(data, 4)
+
+
+def test_utils_clip_global_norm():
+    from mxnet_tpu import utils
+
+    arrs = [np.array([3.0, 0.0]), np.array([0.0, 4.0])]
+    norm = utils.clip_global_norm(arrs, 1.0)
+    assert abs(norm - 5.0) < 1e-5
+    total = sum(float((a ** 2).sum()) for a in arrs)
+    assert abs(total - 1.0) < 1e-3  # rescaled to max_norm
+
+
+def test_name_manager_and_attrscope():
+    from mxnet_tpu import AttrScope, NameManager
+    from mxnet_tpu.name import Prefix
+
+    nm = NameManager()
+    assert nm.get(None, "dense") == "dense0"
+    assert nm.get(None, "dense") == "dense1"
+    assert nm.get("explicit", "dense") == "explicit"
+    with Prefix("net_") as pm:
+        assert pm.get(None, "conv") == "net_conv0"
+    with AttrScope(group="backbone"):
+        assert AttrScope.current().get() == {"group": "backbone"}
+        with AttrScope(lr_mult="0.1"):
+            assert AttrScope.current().get() == {"group": "backbone",
+                                                 "lr_mult": "0.1"}
+    assert AttrScope.current().get() == {}
+
+
+def test_image_iter_over_rec(tmp_path):
+    from mxnet_tpu import image, recordio
+
+    prefix = str(tmp_path / "imgs")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(6):
+        img = onp.full((12, 12, 3), i * 20, dtype="uint8")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 2), i), img, img_fmt=".png"))
+    w.close()
+    it = image.ImageIter(batch_size=3, data_shape=(3, 8, 8),
+                         path_imgrec=prefix + ".rec", rand_crop=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (3, 3, 8, 8)
+    it.reset()
+    assert len(list(it)) == 2
