@@ -118,11 +118,14 @@ class CachedOp:
         return self._jitted.lower(*datas).as_text()
 
 
-def trace(fn, inputs, params=()):
+def trace(fn, inputs, params=(), transform=None):
     """Trace ``fn(*inputs)`` into (outputs_structure, CachedOp).
 
     - ``inputs``: list of NDArrays marked as data variables (in order);
-    - ``params``: list of (name, NDArray) marked as parameter variables.
+    - ``params``: list of (name, NDArray) marked as parameter variables;
+    - ``transform``: optional Symbol -> Symbol pass applied before compile
+      (the optimize_for / subgraph-backend injection point, reference:
+      build_subgraph.cc partitioner before graph bind).
 
     Returns (out_tree, flat_output_ndarrays, cached_op). The CachedOp's call
     order is [*inputs, *param arrays].
@@ -142,6 +145,8 @@ def trace(fn, inputs, params=()):
                 # output unconnected to the trace (constant forward) — bake it
                 o._dc_sym = (_const_node(o), 0)
         sym = Symbol([o._dc_sym for o in flat])
+        if transform is not None:
+            sym = transform(sym)
         cop = CachedOp(sym, var_nodes, aux_updates=ctx.aux_updates)
     return tree, flat, cop
 

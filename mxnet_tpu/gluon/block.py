@@ -194,9 +194,18 @@ class HybridBlock(Block):
         self._cached = {}
         super().hybridize(active, **kwargs)
 
-    def optimize_for(self, x, *args, backend=None, **kwargs):
-        """Reference parity (block.py optimize_for): backends map to XLA;
-        hybridize + warm the cache."""
+    def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
+        """Hybridize with a subgraph-pass backend applied to the traced
+        graph before compilation (reference: block.py optimize_for ->
+        MXOptimizeForBackend). Passes are registered via mx.subgraph."""
+        if kwargs:
+            raise MXNetError(
+                f"optimize_for: unsupported options {sorted(kwargs)} — "
+                "backend-specific options are not implemented; passes "
+                "receive only the Symbol")
+        self._pass_backend = backend
+        if clear:
+            self._cached = {}
         self.hybridize()
         self(x, *args)
 
@@ -263,7 +272,14 @@ class HybridBlock(Block):
                 full[i] = a
             return self.forward(*full, **kwargs)
 
-        tree, _, cop = trace(fn, [args[i] for i in nd_idx], params)
+        transform = None
+        backend = getattr(self, "_pass_backend", None)
+        if backend:
+            from .. import subgraph
+
+            transform = lambda s: subgraph.apply_passes(s, backend)  # noqa: E731
+        tree, _, cop = trace(fn, [args[i] for i in nd_idx], params,
+                             transform=transform)
         return cop, tree, [arr for _, arr in params]
 
     # -- export (reference: block.py:1514) ----------------------------------

@@ -153,12 +153,14 @@ class _Pool(HybridBlock):
         self._type = pool_type
         self._layout = layout
         self._cip = count_include_pad
+        self._ceil = ceil_mode
 
     def forward(self, x):
         return npx.pooling(x, kernel=self._kernel, pool_type=self._type,
                            stride=self._stride, pad=self._pad,
                            global_pool=self._global,
-                           count_include_pad=self._cip, layout=self._layout)
+                           count_include_pad=self._cip, layout=self._layout,
+                           ceil_mode=self._ceil)
 
     def __repr__(self):
         return (f"{type(self).__name__}(size={self._kernel}, "

@@ -87,11 +87,12 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
 
 
 def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
-            global_pool=False, count_include_pad=True, layout=None, **kw):
+            global_pool=False, count_include_pad=True, layout=None,
+            ceil_mode=False, **kw):
     return _op("pooling", _nd(data), kernel=tuple(kernel),
                pool_type=pool_type, stride=tuple(stride), pad=tuple(pad),
                global_pool=global_pool, count_include_pad=count_include_pad,
-               layout=layout)
+               layout=layout, ceil_mode=ceil_mode)
 
 
 def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,

@@ -86,41 +86,59 @@ def _convolution(kernel=(), stride=(), dilate=(), pad=(), num_filter=0,
 @register("deconvolution")
 def _deconvolution(kernel=(), stride=(), dilate=(), pad=(), adj=(),
                    num_filter=0, num_group=1, no_bias=False, layout=None):
-    if num_group != 1:
-        raise MXNetError("grouped deconvolution is not supported yet")
-
     def f(x, w, *b):
-        nd = x.ndim
-        lhs_l, rhs_l, out_l = _conv_dnums(nd, layout)
-        nsp = nd - 2
-        strides = tuple(stride) if stride else (1,) * nsp
-        pads = tuple(pad) if pad else (0,) * nsp
-        adjs = tuple(adj) if adj else (0,) * nsp
-        dil = tuple(dilate) if dilate else (1,) * nsp
-        k = tuple(kernel)
-        # MXNet semantics: out = (in-1)*s + d*(k-1) + 1 - 2p + adj
-        # lax explicit padding pads the stride-dilated input directly:
-        # out = (in-1)*s + 1 + pl + ph - k_eff + 1 with k_eff = d*(k-1)+1
-        # => pl = k_eff - 1 - p, ph = pl + adj
-        keff = [dil[i] * (k[i] - 1) + 1 for i in range(nsp)]
-        padding = [(keff[i] - 1 - pads[i], keff[i] - 1 - pads[i] + adjs[i])
-                   for i in range(nsp)]
-        y = lax.conv_transpose(
-            x, w,
-            strides=strides,
-            padding=padding,
-            rhs_dilation=dil,
-            dimension_numbers=(lhs_l, rhs_l, out_l),
-            transpose_kernel=True,
-        )
-        if not no_bias:
-            c_axis = out_l.index("C")
-            bshape = [1] * nd
-            bshape[c_axis] = b[0].shape[0]
-            y = y + b[0].reshape(bshape)
-        return y
+        if num_group != 1:
+            # grouped transpose conv: split channels, run per group, concat
+            # (lax.conv_transpose has no feature_group_count)
+            lhs_l, _, out_l = _conv_dnums(x.ndim, layout)
+            c_axis = lhs_l.index("C")
+            xs = jnp.split(x, num_group, axis=c_axis)
+            ws = jnp.split(w, num_group, axis=0)
+            parts = [_deconv_one(xi, wi, (), kernel, stride, dilate, pad,
+                                 adj, True, layout)
+                     for xi, wi in zip(xs, ws)]
+            y = jnp.concatenate(parts, axis=out_l.index("C"))
+            if not no_bias:
+                bshape = [1] * x.ndim
+                bshape[out_l.index("C")] = b[0].shape[0]
+                y = y + b[0].reshape(bshape)
+            return y
+        return _deconv_one(x, w, b, kernel, stride, dilate, pad, adj,
+                           no_bias, layout)
 
     return f
+
+
+def _deconv_one(x, w, b, kernel, stride, dilate, pad, adj, no_bias, layout):
+    nd = x.ndim
+    lhs_l, rhs_l, out_l = _conv_dnums(nd, layout)
+    nsp = nd - 2
+    strides = tuple(stride) if stride else (1,) * nsp
+    pads = tuple(pad) if pad else (0,) * nsp
+    adjs = tuple(adj) if adj else (0,) * nsp
+    dil = tuple(dilate) if dilate else (1,) * nsp
+    k = tuple(kernel)
+    # MXNet semantics: out = (in-1)*s + d*(k-1) + 1 - 2p + adj
+    # lax explicit padding pads the stride-dilated input directly:
+    # out = (in-1)*s + 1 + pl + ph - k_eff + 1 with k_eff = d*(k-1)+1
+    # => pl = k_eff - 1 - p, ph = pl + adj
+    keff = [dil[i] * (k[i] - 1) + 1 for i in range(nsp)]
+    padding = [(keff[i] - 1 - pads[i], keff[i] - 1 - pads[i] + adjs[i])
+               for i in range(nsp)]
+    y = lax.conv_transpose(
+        x, w,
+        strides=strides,
+        padding=padding,
+        rhs_dilation=dil,
+        dimension_numbers=(lhs_l, rhs_l, out_l),
+        transpose_kernel=True,
+    )
+    if not no_bias:
+        c_axis = out_l.index("C")
+        bshape = [1] * nd
+        bshape[c_axis] = b[0].shape[0]
+        y = y + b[0].reshape(bshape)
+    return y
 
 
 # ---------------------------------------------------------------------------
@@ -128,7 +146,7 @@ def _deconvolution(kernel=(), stride=(), dilate=(), pad=(), adj=(),
 # ---------------------------------------------------------------------------
 @register("pooling")
 def _pooling(kernel=(), pool_type="max", stride=(), pad=(), global_pool=False,
-             count_include_pad=True, layout=None):
+             count_include_pad=True, layout=None, ceil_mode=False):
     def f(x):
         nd = x.ndim
         lay = layout or {3: "NCW", 4: "NCHW", 5: "NCDHW"}[nd]
@@ -147,20 +165,34 @@ def _pooling(kernel=(), pool_type="max", stride=(), pad=(), global_pool=False,
         for i, ax in enumerate(sp_axes):
             wdims[ax] = k[i]
             wstr[ax] = strides[i]
-            wpad[ax] = (pads[i], pads[i])
+            extra = 0
+            if ceil_mode:
+                # include the last partial window (reference pooling.cc
+                # ceil rounding): pad right so the window grid covers it
+                span = x.shape[ax] + 2 * pads[i] - k[i]
+                rem = span % strides[i]
+                if rem:
+                    extra = strides[i] - rem
+            wpad[ax] = (pads[i], pads[i] + extra)
         if pool_type == "max":
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
                 jnp.iinfo(x.dtype).min
             return lax.reduce_window(x, init, lax.max, wdims, wstr, wpad)
         s = lax.reduce_window(x, 0.0, lax.add, wdims, wstr, wpad)
+        # divisor (reference pool.h:468-479): symmetric padding counts when
+        # count_include_pad, but the ceil-mode extra region NEVER does — so
+        # count window positions over a mask that is 1 on data (+sym pad if
+        # include_pad) and 0 on the ceil extra
+        ones = jnp.ones(x.shape, jnp.float32)
         if count_include_pad:
-            denom = 1
-            for i in range(nsp):
-                denom *= k[i]
-            return s / denom
-        ones = jnp.ones(x.shape, x.dtype)
-        cnt = lax.reduce_window(ones, 0.0, lax.add, wdims, wstr, wpad)
-        return s / cnt
+            mask_pad = [(lo, lo) for lo, _ in wpad]  # symmetric part = 1s
+            ones = jnp.pad(ones, mask_pad, constant_values=1.0)
+            extra_pad = [(0, hi - lo) for lo, hi in wpad]
+            cnt = lax.reduce_window(ones, 0.0, lax.add, wdims, wstr,
+                                    extra_pad)
+        else:
+            cnt = lax.reduce_window(ones, 0.0, lax.add, wdims, wstr, wpad)
+        return s / cnt.astype(s.dtype)
 
     return f
 
