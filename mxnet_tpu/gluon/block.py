@@ -192,6 +192,7 @@ class HybridBlock(Block):
     def hybridize(self, active=True, **kwargs):
         self._active = active
         self._cached = {}
+        self._pass_backend = None  # re-hybridizing restores vanilla compile
         super().hybridize(active, **kwargs)
 
     def optimize_for(self, x, *args, backend=None, clear=True, **kwargs):
@@ -203,10 +204,10 @@ class HybridBlock(Block):
                 f"optimize_for: unsupported options {sorted(kwargs)} — "
                 "backend-specific options are not implemented; passes "
                 "receive only the Symbol")
+        kept = {} if clear else dict(self._cached)
+        self.hybridize()  # wipes caches and resets any previous backend
         self._pass_backend = backend
-        if clear:
-            self._cached = {}
-        self.hybridize()
+        self._cached.update(kept)  # clear=False keeps prior compiled graphs
         self(x, *args)
 
     def infer_shape(self, *args):

@@ -179,6 +179,13 @@ def _pooling(kernel=(), pool_type="max", stride=(), pad=(), global_pool=False,
                 jnp.iinfo(x.dtype).min
             return lax.reduce_window(x, init, lax.max, wdims, wstr, wpad)
         s = lax.reduce_window(x, 0.0, lax.add, wdims, wstr, wpad)
+        has_extra = any(hi != lo for lo, hi in wpad)
+        if count_include_pad and not has_extra:
+            # constant divisor fast path (the default config)
+            denom = 1
+            for i in range(nsp):
+                denom *= k[i]
+            return s / denom
         # divisor (reference pool.h:468-479): symmetric padding counts when
         # count_include_pad, but the ceil-mode extra region NEVER does — so
         # count window positions over a mask that is 1 on data (+sym pad if

@@ -1,0 +1,179 @@
+"""NN operator numerics vs manual references (reference:
+tests/python/unittest/test_operator.py — op-by-op numerical checks)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, rand_ndarray)
+
+
+def _conv2d_ref(x, w, stride=1, pad=0):
+    """Direct-loop conv reference (NCHW, OIHW)."""
+    n, c, h, wd = x.shape
+    o, _, kh, kw = w.shape
+    xp = onp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = onp.zeros((n, o, oh, ow), dtype="float32")
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = onp.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+@pytest.mark.parametrize("stride,pad", [(1, 0), (1, 1), (2, 1)])
+def test_conv2d_vs_loop_reference(stride, pad):
+    x = onp.random.randn(2, 3, 8, 8).astype("float32")
+    w = onp.random.randn(4, 3, 3, 3).astype("float32")
+    got = npx.convolution(np.array(x), np.array(w), kernel=(3, 3),
+                          stride=(stride, stride), pad=(pad, pad),
+                          num_filter=4, no_bias=True)
+    assert_almost_equal(got, _conv2d_ref(x, w, stride, pad), rtol=1e-3,
+                        atol=1e-3)
+
+
+def test_conv_gradient_numeric():
+    x = rand_ndarray((1, 2, 5, 5))
+    w = rand_ndarray((3, 2, 3, 3))
+
+    def f(xs):
+        return npx.convolution(xs[0], xs[1], kernel=(3, 3), num_filter=3,
+                               no_bias=True).sum()
+
+    check_numeric_gradient(f, [x, w])
+
+
+def test_maxpool_vs_manual():
+    x = onp.random.randn(1, 2, 6, 6).astype("float32")
+    got = npx.pooling(np.array(x), kernel=(2, 2), stride=(2, 2),
+                      pool_type="max")
+    ref = x.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+    assert_almost_equal(got, ref)
+
+
+def test_avgpool_vs_manual():
+    x = onp.random.randn(1, 2, 6, 6).astype("float32")
+    got = npx.pooling(np.array(x), kernel=(2, 2), stride=(2, 2),
+                      pool_type="avg")
+    ref = x.reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5))
+    assert_almost_equal(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_batch_norm_numerics():
+    x = onp.random.randn(4, 3, 5, 5).astype("float32")
+    gamma = onp.random.rand(3).astype("float32") + 0.5
+    beta = onp.random.randn(3).astype("float32")
+    rm = onp.zeros(3, "float32")
+    rv = onp.ones(3, "float32")
+    with autograd.train_mode():
+        out, new_m, new_v = npx.batch_norm(
+            np.array(x), np.array(gamma), np.array(beta), np.array(rm),
+            np.array(rv), eps=1e-5, momentum=0.9)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / \
+        onp.sqrt(var[None, :, None, None] + 1e-5) * \
+        gamma[None, :, None, None] + beta[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-3)
+    assert_almost_equal(new_m, 0.9 * rm + 0.1 * mean, rtol=1e-4, atol=1e-5)
+    # eval mode uses running stats
+    out_eval, _, _ = npx.batch_norm(
+        np.array(x), np.array(gamma), np.array(beta), np.array(rm),
+        np.array(rv), eps=1e-5)
+    ref_eval = x * gamma[None, :, None, None] / onp.sqrt(1 + 1e-5) + \
+        beta[None, :, None, None]
+    assert_almost_equal(out_eval, ref_eval, rtol=1e-3, atol=1e-3)
+
+
+def test_layer_norm_gradient():
+    x = rand_ndarray((3, 8))
+    g = rand_ndarray((8,), low=0.5, high=1.5)
+    b = rand_ndarray((8,))
+
+    def f(xs):
+        return (npx.layer_norm(xs[0], xs[1], xs[2]) *
+                np.arange(8).astype("float32")).sum()
+
+    check_numeric_gradient(f, [x, g, b])
+
+
+def test_softmax_gradient():
+    x = rand_ndarray((4, 6))
+
+    def f(xs):
+        return (npx.softmax(xs[0]) ** 2).sum()
+
+    check_numeric_gradient(f, [x])
+
+
+def test_fully_connected_gradient():
+    x = rand_ndarray((3, 5))
+    w = rand_ndarray((4, 5))
+    b = rand_ndarray((4,))
+
+    def f(xs):
+        return (npx.fully_connected(xs[0], xs[1], xs[2], num_hidden=4) *
+                np.arange(4).astype("float32")).sum()
+
+    check_numeric_gradient(f, [x, w, b])
+
+
+def test_embedding_gradient_scatter():
+    idx = np.array([0, 2, 2])
+    w = rand_ndarray((4, 3))
+    w.attach_grad()
+    with autograd.record():
+        out = npx.embedding(idx, w).sum()
+    out.backward()
+    g = w.grad.asnumpy()
+    assert_almost_equal(g[0], onp.ones(3))
+    assert_almost_equal(g[2], 2 * onp.ones(3))  # duplicate index accumulates
+    assert_almost_equal(g[1], onp.zeros(3))
+
+
+def test_sequence_ops():
+    x = onp.arange(24, dtype="float32").reshape(4, 2, 3)  # (T, B, C)
+    length = np.array([2, 4])
+    masked = npx.sequence_mask(np.array(x), length,
+                               use_sequence_length=True, value=-1.0)
+    m = masked.asnumpy()
+    assert (m[2:, 0] == -1.0).all()
+    assert (m[:, 1] == x[:, 1]).all()
+    last = npx.sequence_last(np.array(x), length, use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x[1, 0])
+    assert_almost_equal(last.asnumpy()[1], x[3, 1])
+    rev = npx.sequence_reverse(np.array(x), length,
+                               use_sequence_length=True)
+    r = rev.asnumpy()
+    assert_almost_equal(r[0, 0], x[1, 0])
+    assert_almost_equal(r[1, 0], x[0, 0])
+    assert_almost_equal(r[2:, 0], x[2:, 0])  # beyond length: untouched
+
+
+def test_dropout_statistics_and_grad():
+    x = np.ones((64, 64))
+    x.attach_grad()
+    with autograd.record():
+        y = npx.dropout(x, p=0.3)
+        s = y.sum()
+    s.backward()
+    out = y.asnumpy()
+    drop_rate = (out == 0).mean()
+    assert 0.2 < drop_rate < 0.4
+    g = x.grad.asnumpy()
+    # gradient is the same mask scaled by 1/keep
+    assert_almost_equal((g == 0), (out == 0))
+
+
+def test_ctc_loss_gradient_flows():
+    pred = rand_ndarray((6, 2, 5))  # (T, B, V)
+    pred.attach_grad()
+    label = np.array([[1, 2], [3, 4]])
+    with autograd.record():
+        loss = npx.ctc_loss(pred, label).sum()
+    loss.backward()
+    assert float(abs(pred.grad).sum()) > 0

@@ -1,0 +1,84 @@
+"""Thread-local state isolation (reference: tests/python/unittest/
+test_thread_local.py — autograd/attr/name state must not leak across
+threads)."""
+import threading
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+
+
+def test_autograd_recording_is_thread_local():
+    results = {}
+
+    def worker():
+        results["worker_recording"] = autograd.is_recording()
+        with autograd.record():
+            results["worker_inside"] = autograd.is_recording()
+
+    with autograd.record():
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert autograd.is_recording()
+    assert results["worker_recording"] is False  # not inherited
+    assert results["worker_inside"] is True
+
+
+def test_context_stack_is_thread_local():
+    results = {}
+
+    def worker():
+        results["ctx"] = mx.current_context()
+
+    default = mx.context.default_context()
+    # push a NON-default context in the main thread; the worker must see
+    # the thread default, not the main thread's pushed scope
+    pushed = mx.cpu(1) if default != mx.cpu(1) else mx.cpu(0)
+    with pushed:
+        assert mx.current_context() == pushed
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert results["ctx"] == default
+    assert results["ctx"] != pushed or default == pushed
+
+
+def test_attrscope_thread_local():
+    from mxnet_tpu import AttrScope
+
+    results = {}
+
+    def worker():
+        results["attrs"] = AttrScope.current().get()
+
+    with AttrScope(group="main-thread"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert results["attrs"] == {}  # scope not visible across threads
+
+
+def test_concurrent_tape_isolation():
+    """Two threads recording simultaneously must not cross tapes."""
+    errors = []
+
+    def train(seed):
+        try:
+            x = np.array([float(seed)])
+            x.attach_grad()
+            for _ in range(10):
+                with autograd.record():
+                    y = (x * x).sum()
+                y.backward()
+                got = float(x.grad)
+                if abs(got - 2 * seed) > 1e-5:
+                    errors.append((seed, got))
+        except Exception as e:  # noqa: BLE001
+            errors.append((seed, repr(e)))
+
+    threads = [threading.Thread(target=train, args=(s,)) for s in (2, 3, 5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
