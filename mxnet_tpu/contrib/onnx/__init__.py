@@ -1,17 +1,22 @@
 """ONNX interchange (reference: python/mxnet/contrib/onnx — mx2onnx
-export_model, onnx2mx import_model).
+export_model:31, onnx2mx import_model).
 
-The zero-egress build environment ships no ``onnx`` package, so protobuf
-serialization is unavailable; these entry points are gated. The framework's
-own interchange format (Symbol JSON + .npz parameters via
-``HybridBlock.export`` / ``SymbolBlock.imports``) covers model deployment
-within the framework.
+Implemented WITHOUT the onnx package: the wire format is written/read by an
+in-tree protobuf codec (_proto.py). Covered op set: Dense/Gemm, Conv,
+pooling (incl. global/ceil), BatchNorm (inference), activations (relu/
+sigmoid/tanh/leaky/elu/gelu-by-erf), softmax/log_softmax, LayerNorm,
+reshape/flatten/transpose/concat/squeeze/unsqueeze, Gather/embedding,
+elementwise arithmetic, dropout (exported as Identity). Ops outside the set
+raise MXNetError naming the op. If a real ``onnx`` package is present it is
+NOT required — files round-trip through this codec.
 """
 from __future__ import annotations
 
+import numpy as onp
+
 from ...base import MXNetError
 
-__all__ = ["export_model", "import_model"]
+__all__ = ["export_model", "import_model", "import_to_gluon"]
 
 try:
     import onnx as _onnx  # noqa: F401
@@ -21,21 +26,64 @@ except ImportError:
     HAS_ONNX = False
 
 
-def export_model(sym, params, input_shape=None, input_type=None,
+def export_model(sym, params=None, input_shape=None, input_type=None,
                  onnx_file_path="model.onnx", **kwargs):
-    """reference: mx2onnx/export_model:31."""
-    if not HAS_ONNX:
-        raise MXNetError(
-            "the 'onnx' package is not installed in this environment; use "
-            "HybridBlock.export (Symbol JSON + .npz) for deployment, or "
-            "install onnx to enable this exporter")
-    raise NotImplementedError("onnx graph construction pending")
+    """Export a Symbol (or HybridBlock) to an .onnx file.
+
+    - Symbol: pass ``params`` (name -> NDArray/numpy) and ``input_shape``
+      ({name: shape} or a list matching non-param variables).
+    - HybridBlock: pass ``input_shape`` as one data shape; the block is
+      traced and its parameters baked in.
+    """
+    from .mx2onnx import export_symbol
+    from ...gluon.block import HybridBlock
+    from ...ndarray.ndarray import NDArray
+
+    if isinstance(sym, HybridBlock):
+        import mxnet_tpu as mx
+        from ...cached_op import trace
+
+        if input_shape is None:
+            raise MXNetError("export_model(block): input_shape required")
+        if isinstance(input_shape, list) and input_shape and \
+                not isinstance(input_shape[0], int):
+            shape = input_shape[0]  # list of shapes: first data input
+        else:
+            shape = input_shape  # a single shape (tuple or int list)
+        x = mx.np.zeros(tuple(shape))
+        block = sym
+        with mx.autograd.predict_mode():
+            block(x)  # settle deferred init
+            param_list = [(n, p.data())
+                          for n, p in block.collect_params().items()
+                          if p._data is not None]
+            _, _, cop = trace(lambda a: block(a), [x], param_list)
+        params_np = {n: arr.asnumpy() for n, arr in param_list}
+        return export_symbol(cop.sym, params_np, {"data0": tuple(shape)},
+                             onnx_file_path)
+
+    params = params or {}
+    params_np = {k: (v.asnumpy() if isinstance(v, NDArray)
+                     else onp.asarray(v)) for k, v in params.items()}
+    if isinstance(input_shape, dict):
+        shapes = {k: tuple(v) for k, v in input_shape.items()}
+    else:
+        free = [n for n in sym.list_arguments() if n not in params_np]
+        if input_shape is None or len(free) != len(input_shape):
+            raise MXNetError(
+                f"export_model: need shapes for inputs {free}")
+        shapes = dict(zip(free, [tuple(s) for s in input_shape]))
+    return export_symbol(sym, params_np, shapes, onnx_file_path)
 
 
 def import_model(model_file):
-    """reference: onnx2mx import_model."""
-    if not HAS_ONNX:
-        raise MXNetError(
-            "the 'onnx' package is not installed in this environment; use "
-            "SymbolBlock.imports for framework-native models")
-    raise NotImplementedError("onnx graph import pending")
+    """reference: onnx2mx import_model -> (sym, arg_params, aux_params)."""
+    from .onnx2mx import import_model as _imp
+
+    return _imp(model_file)
+
+
+def import_to_gluon(model_file, input_names=None):
+    from .onnx2mx import import_to_gluon as _imp
+
+    return _imp(model_file, input_names)

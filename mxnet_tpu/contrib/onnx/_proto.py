@@ -1,0 +1,158 @@
+"""Minimal protobuf wire-format codec for the ONNX subset we emit/consume.
+
+The zero-egress image has no ``onnx`` package, but the protobuf wire format
+and ONNX's field numbers are stable public specification — enough to write
+valid .onnx files (and read back the subset we write) without the library.
+
+Wire types: 0 = varint, 1 = 64-bit, 2 = length-delimited, 5 = 32-bit.
+Field numbers follow onnx.proto3 (ModelProto, GraphProto, NodeProto,
+TensorProto, ValueInfoProto, AttributeProto, OperatorSetIdProto).
+"""
+from __future__ import annotations
+
+import struct
+
+# ---------------------------------------------------------------------------
+# primitive writers
+# ---------------------------------------------------------------------------
+
+
+def _varint(value: int) -> bytes:
+    if value < 0:
+        value += 1 << 64  # two's complement for negative int64
+    out = bytearray()
+    while True:
+        byte = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(byte | 0x80)
+        else:
+            out.append(byte)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def w_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def w_bytes(field: int, data: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(data)) + data
+
+
+def w_string(field: int, s: str) -> bytes:
+    return w_bytes(field, s.encode("utf-8"))
+
+
+def w_float(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+def w_msg(field: int, payload: bytes) -> bytes:
+    return w_bytes(field, payload)
+
+
+# ---------------------------------------------------------------------------
+# primitive readers
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, pos: int):
+    result = 0
+    shift = 0
+    while True:
+        byte = buf[pos]
+        pos += 1
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            break
+        shift += 7
+    if result >= 1 << 63:  # negative int64
+        result -= 1 << 64
+    return result, pos
+
+
+def iter_fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message payload."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            value, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            length, pos = _read_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire == 5:
+            value = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wire == 1:
+            value = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, value
+
+
+def collect(buf: bytes):
+    """Group message fields into {field: [values...]}."""
+    out: dict = {}
+    for field, _, value in iter_fields(buf):
+        out.setdefault(field, []).append(value)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ONNX dtype enum (TensorProto.DataType)
+# ---------------------------------------------------------------------------
+FLOAT = 1
+INT64 = 7
+INT32 = 6
+BOOL = 9
+
+_NP_TO_ONNX = {"float32": FLOAT, "int64": INT64, "int32": INT32,
+               "bool": BOOL}
+_ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
+
+
+def np_to_onnx_dtype(dtype) -> int:
+    return _NP_TO_ONNX[str(dtype)]
+
+
+def onnx_to_np_dtype(code: int) -> str:
+    return _ONNX_TO_NP[code]
+
+
+def unpack_varints(value):
+    """Decode a packed repeated varint field (proto3 default packing)."""
+    if isinstance(value, int):
+        return [value]
+    out = []
+    pos = 0
+    while pos < len(value):
+        v, pos = _read_varint(value, pos)
+        out.append(v)
+    return out
+
+
+def unpack_floats(value):
+    """Decode a packed repeated float field."""
+    if isinstance(value, float):
+        return [value]
+    return list(struct.unpack(f"<{len(value) // 4}f", value))
+
+
+def scalars(values, kind="int"):
+    """Normalize a mix of packed/unpacked repeated scalars."""
+    out = []
+    for v in values:
+        if kind == "int":
+            out.extend(unpack_varints(v))
+        else:
+            out.extend(unpack_floats(v))
+    return out

@@ -1,0 +1,324 @@
+"""Export a Symbol graph to ONNX (reference: contrib/onnx/mx2onnx
+export_model:31). Emits opset-13-compatible nodes for the core op set via
+the in-tree protobuf codec (_proto.py) — no onnx package required.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...symbol.symbol import Literal, Symbol, topo_sort
+from . import _proto as P
+
+OPSET = 13
+
+
+def _tensor_proto(name, arr) -> bytes:
+    arr = onp.ascontiguousarray(arr)
+    payload = b"".join(P.w_varint(1, d) for d in arr.shape)
+    payload += P.w_varint(2, P.np_to_onnx_dtype(arr.dtype))
+    payload += P.w_string(8, name)
+    payload += P.w_bytes(9, arr.tobytes())
+    return payload
+
+
+def _value_info(name, shape, dtype="float32") -> bytes:
+    dims = b"".join(P.w_msg(1, P.w_varint(1, d)) for d in shape)
+    tensor_type = P.w_varint(1, P.np_to_onnx_dtype(dtype)) + \
+        P.w_msg(2, dims)
+    return P.w_string(1, name) + P.w_msg(2, P.w_msg(1, tensor_type))
+
+
+def _attr_i(name, value) -> bytes:
+    return P.w_msg(5, P.w_string(1, name) + P.w_varint(3, value) +
+                   P.w_varint(20, 2))
+
+
+def _attr_f(name, value) -> bytes:
+    return P.w_msg(5, P.w_string(1, name) + P.w_float(2, value) +
+                   P.w_varint(20, 1))
+
+
+def _attr_ints(name, values) -> bytes:
+    body = P.w_string(1, name) + \
+        b"".join(P.w_varint(8, v) for v in values) + P.w_varint(20, 7)
+    return P.w_msg(5, body)
+
+
+def _node(op_type, inputs, outputs, attrs=b"", name="") -> bytes:
+    payload = b"".join(P.w_string(1, i) for i in inputs)
+    payload += b"".join(P.w_string(2, o) for o in outputs)
+    if name:
+        payload += P.w_string(3, name)
+    payload += P.w_string(4, op_type)
+    payload += attrs
+    return P.w_msg(1, payload)
+
+
+class _Exporter:
+    """Per-op converters from registry ops to ONNX nodes."""
+
+    def __init__(self, params):
+        self.params = params          # name -> numpy array
+        self.nodes: list[bytes] = []
+        self.initializers: list[bytes] = []
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def add_initializer(self, name, arr):
+        self.initializers.append(P.w_msg(5, _tensor_proto(name, arr)))
+
+    def convert(self, node, in_names, out_names):
+        op = node.op.name
+        a = node.attrs
+        fn = getattr(self, f"cv_{op}", None)
+        if fn is None:
+            simple = _SIMPLE_OPS.get(op)
+            if simple is None:
+                raise MXNetError(
+                    f"ONNX export: op '{op}' has no converter yet")
+            self.nodes.append(_node(simple, in_names, out_names))
+            return
+        fn(a, in_names, out_names)
+
+    # -- converters ---------------------------------------------------------
+    def cv_fully_connected(self, a, ins, outs):
+        x = ins[0]
+        if a.get("flatten", True):
+            flat = self.fresh("flat")
+            self.nodes.append(_node("Flatten", [x], [flat],
+                                    _attr_i("axis", 1)))
+            x = flat
+        attrs = _attr_i("transB", 1)
+        if len(ins) >= 3:
+            self.nodes.append(_node("Gemm", [x, ins[1], ins[2]], outs,
+                                    attrs))
+        else:
+            self.nodes.append(_node("Gemm", [x, ins[1]], outs, attrs))
+
+    def cv_convolution(self, a, ins, outs):
+        k = list(a.get("kernel", ()))
+        nsp = len(k)
+        stride = list(a.get("stride", ())) or [1] * nsp
+        pad = list(a.get("pad", ())) or [0] * nsp
+        dil = list(a.get("dilate", ())) or [1] * nsp
+        attrs = (_attr_ints("kernel_shape", k) +
+                 _attr_ints("strides", stride) +
+                 _attr_ints("pads", pad + pad) +
+                 _attr_ints("dilations", dil) +
+                 _attr_i("group", a.get("num_group", 1)))
+        self.nodes.append(_node("Conv", ins, outs, attrs))
+
+    def cv_pooling(self, a, ins, outs):
+        if a.get("global_pool"):
+            op = "GlobalMaxPool" if a.get("pool_type") == "max" else \
+                "GlobalAveragePool"
+            self.nodes.append(_node(op, ins, outs))
+            return
+        k = list(a.get("kernel", ()))
+        nsp = len(k)
+        stride = list(a.get("stride", ())) or [1] * nsp
+        pad = list(a.get("pad", ())) or [0] * nsp
+        attrs = (_attr_ints("kernel_shape", k) +
+                 _attr_ints("strides", stride) +
+                 _attr_ints("pads", pad + pad))
+        if a.get("ceil_mode"):
+            attrs += _attr_i("ceil_mode", 1)
+        op = "MaxPool" if a.get("pool_type", "max") == "max" else \
+            "AveragePool"
+        if op == "AveragePool":
+            attrs += _attr_i("count_include_pad",
+                             1 if a.get("count_include_pad", True) else 0)
+        self.nodes.append(_node(op, ins, outs, attrs))
+
+    def cv_batch_norm(self, a, ins, outs):
+        # our BN node: (x, gamma, beta, mean, var) -> (out, new_m, new_v);
+        # ONNX inference BN consumes the same 5 inputs -> 1 output
+        attrs = _attr_f("epsilon", float(a.get("eps", 1e-5))) + \
+            _attr_f("momentum", float(a.get("momentum", 0.9)))
+        self.nodes.append(_node("BatchNormalization", ins[:5],
+                                [outs[0]], attrs))
+        # downstream nodes may reference new_m/new_v only via aux writes,
+        # which export drops (inference graphs)
+
+    def cv_activation(self, a, ins, outs):
+        table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+                 "softrelu": "Softplus", "softsign": "Softsign"}
+        act = a.get("act_type", "relu")
+        if act not in table:
+            raise MXNetError(f"ONNX export: activation {act!r} unsupported")
+        self.nodes.append(_node(table[act], ins, outs))
+
+    def cv_leaky_relu(self, a, ins, outs):
+        act = a.get("act_type", "leaky")
+        if act == "leaky":
+            self.nodes.append(_node("LeakyRelu", ins, outs,
+                                    _attr_f("alpha",
+                                            float(a.get("slope", 0.25)))))
+        elif act == "elu":
+            self.nodes.append(_node("Elu", ins, outs,
+                                    _attr_f("alpha",
+                                            float(a.get("slope", 1.0)))))
+        elif act in ("gelu", "gelu_tanh"):
+            # opset<20 has no Gelu: emit the erf formulation
+            half = self.fresh("c")
+            one = self.fresh("c")
+            sqrt2 = self.fresh("c")
+            for nm, v in ((half, 0.5), (one, 1.0), (sqrt2, 2 ** 0.5)):
+                self.add_initializer(nm, onp.asarray(v, "float32"))
+            t1, t2, t3, t4 = (self.fresh() for _ in range(4))
+            self.nodes.append(_node("Div", [ins[0], sqrt2], [t1]))
+            self.nodes.append(_node("Erf", [t1], [t2]))
+            self.nodes.append(_node("Add", [t2, one], [t3]))
+            self.nodes.append(_node("Mul", [ins[0], t3], [t4]))
+            self.nodes.append(_node("Mul", [t4, half], outs))
+        else:
+            raise MXNetError(f"ONNX export: leaky_relu {act!r} unsupported")
+
+    def cv_softmax(self, a, ins, outs):
+        self.nodes.append(_node("Softmax", ins[:1], outs,
+                                _attr_i("axis", a.get("axis", -1))))
+
+    def cv_log_softmax(self, a, ins, outs):
+        self.nodes.append(_node("LogSoftmax", ins[:1], outs,
+                                _attr_i("axis", a.get("axis", -1))))
+
+    def cv_reshape(self, a, ins, outs):
+        shape_name = self.fresh("shape")
+        ns = a.get("newshape")
+        ns = (ns,) if isinstance(ns, int) else tuple(ns)
+        self.add_initializer(shape_name, onp.asarray(ns, "int64"))
+        self.nodes.append(_node("Reshape", [ins[0], shape_name], outs))
+
+    def cv_flatten(self, a, ins, outs):
+        self.nodes.append(_node("Flatten", ins, outs, _attr_i("axis", 1)))
+
+    def cv_transpose(self, a, ins, outs):
+        axes = a.get("axes")
+        attrs = _attr_ints("perm", list(axes)) if axes else b""
+        self.nodes.append(_node("Transpose", ins, outs, attrs))
+
+    def cv_concatenate(self, a, ins, outs):
+        self.nodes.append(_node("Concat", ins, outs,
+                                _attr_i("axis", a.get("axis", 0))))
+
+    def cv_expand_dims(self, a, ins, outs):
+        ax = self.fresh("axes")
+        self.add_initializer(ax, onp.asarray([a.get("axis", 0)], "int64"))
+        self.nodes.append(_node("Unsqueeze", [ins[0], ax], outs))
+
+    def cv_squeeze(self, a, ins, outs):
+        axis = a.get("axis")
+        if axis is None:
+            self.nodes.append(_node("Squeeze", ins, outs))
+        else:
+            ax = self.fresh("axes")
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            self.add_initializer(ax, onp.asarray(axes, "int64"))
+            self.nodes.append(_node("Squeeze", [ins[0], ax], outs))
+
+    def cv_dropout(self, a, ins, outs):
+        self.nodes.append(_node("Identity", ins[:1], outs))  # inference
+
+    def cv_embedding(self, a, ins, outs):
+        # our op order is (indices, weight); ONNX Gather is (data, indices)
+        self.nodes.append(_node("Gather", [ins[1], ins[0]], outs,
+                                _attr_i("axis", 0)))
+
+    def cv_layer_norm(self, a, ins, outs):
+        attrs = _attr_i("axis", a.get("axis", -1)) + \
+            _attr_f("epsilon", float(a.get("eps", 1e-5)))
+        self.nodes.append(_node("LayerNormalization", ins, outs, attrs))
+
+
+_SIMPLE_OPS = {
+    "add": "Add", "subtract": "Sub", "multiply": "Mul",
+    "true_divide": "Div", "matmul": "MatMul", "dot": "MatMul",
+    "relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh", "exp": "Exp",
+    "log": "Log", "sqrt": "Sqrt", "abs": "Abs", "negative": "Neg",
+    "floor": "Floor", "ceil": "Ceil", "erf": "Erf", "power": "Pow",
+    "maximum": "Max", "minimum": "Min", "copy": "Identity",
+    "stop_gradient": "Identity",
+}
+
+
+def export_symbol(sym: Symbol, params: dict, input_shapes: dict,
+                  onnx_file_path="model.onnx", producer="mxnet_tpu"):
+    """Write an ONNX ModelProto for ``sym`` with ``params`` baked as
+    initializers. ``input_shapes``: name -> shape for the data inputs."""
+    nodes = topo_sort(sym._entries)
+    exp = _Exporter(params)
+    names: dict[tuple, str] = {}
+
+    def out_name(node, idx):
+        key = (id(node), idx)
+        if key not in names:
+            base = node.name or f"n{node.seq}"
+            names[key] = base if idx == 0 else f"{base}_{idx}"
+        return names[key]
+
+    graph_inputs = []
+    for node in nodes:
+        if node.is_var:
+            name = node.name
+            names[(id(node), 0)] = name
+            if name in params:
+                exp.add_initializer(name, onp.asarray(params[name]))
+            elif name in input_shapes:
+                graph_inputs.append(
+                    _value_info(name, input_shapes[name]))
+            else:
+                raise MXNetError(
+                    f"ONNX export: variable {name!r} has neither a param "
+                    "value nor an input shape")
+        elif node.is_const:
+            cname = f"const_{node.seq}"
+            names[(id(node), 0)] = cname
+            exp.add_initializer(cname, onp.asarray(node.value))
+        else:
+            ins = []
+            for e in node.inputs:
+                if isinstance(e, Literal):
+                    lname = exp.fresh("lit")
+                    exp.add_initializer(
+                        lname, onp.asarray(e.value, "float32"))
+                    ins.append(lname)
+                else:
+                    ins.append(out_name(e[0], e[1]))
+            outs = [out_name(node, i) for i in range(node.nout)]
+            exp.convert(node, ins, outs)
+
+    # typed outputs (spec requires type on graph outputs): infer shapes
+    # through the executor with input + param shapes
+    all_shapes = dict(input_shapes)
+    for pname, arr in params.items():
+        all_shapes[pname] = tuple(onp.asarray(arr).shape)
+    try:
+        _, out_shapes, _ = sym.infer_shape(**all_shapes)
+    except Exception:  # noqa: BLE001 — fall back to untyped names
+        out_shapes = [None] * len(sym._entries)
+    graph_outputs = []
+    for (node, idx), oshape in zip(sym._entries, out_shapes):
+        nm = out_name(node, idx)
+        if oshape is not None:
+            graph_outputs.append(_value_info(nm, oshape))
+        else:
+            graph_outputs.append(P.w_string(1, nm))
+
+    graph = b"".join(exp.nodes)
+    graph += P.w_string(2, "mxnet_tpu_graph")
+    graph += b"".join(exp.initializers)
+    graph += b"".join(P.w_msg(11, gi) for gi in graph_inputs)
+    graph += b"".join(P.w_msg(12, go) for go in graph_outputs)
+
+    model = P.w_varint(1, 8)  # ir_version 8
+    model += P.w_string(2, producer)
+    model += P.w_msg(7, graph)
+    model += P.w_msg(8, P.w_varint(2, OPSET))  # default-domain opset
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model)
+    return onnx_file_path

@@ -1,0 +1,267 @@
+"""Import ONNX models into a Symbol graph (reference: contrib/onnx onnx2mx
+import_model). Covers the node subset mx2onnx emits plus common aliases.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ...symbol.symbol import Symbol
+from . import _proto as P
+
+
+def _parse_tensor(buf: bytes):
+    fields = P.collect(buf)
+    dims = tuple(P.scalars(fields.get(1, [])))
+    dtype = P.onnx_to_np_dtype(fields.get(2, [P.FLOAT])[0])
+    name = fields.get(8, [b""])[0].decode()
+    if 9 in fields:  # raw_data
+        arr = onp.frombuffer(fields[9][0], dtype=dtype).reshape(dims)
+    elif 4 in fields:  # float_data
+        arr = onp.asarray(fields[4], dtype="float32").reshape(dims)
+    elif 7 in fields:  # int64_data
+        arr = onp.asarray(fields[7], dtype="int64").reshape(dims)
+    else:
+        arr = onp.zeros(dims, dtype=dtype)
+    return name, arr
+
+
+def _parse_attrs(attr_bufs):
+    attrs = {}
+    for buf in attr_bufs:
+        fields = P.collect(buf)
+        name = fields[1][0].decode()
+        atype = fields.get(20, [0])[0]
+        if atype == 1:
+            attrs[name] = fields[2][0]
+        elif atype == 2:
+            attrs[name] = fields[3][0]
+        elif atype == 3:
+            attrs[name] = fields[4][0].decode()
+        elif atype == 7:
+            attrs[name] = tuple(P.scalars(fields.get(8, [])))
+        elif atype == 6:
+            attrs[name] = tuple(P.scalars(fields.get(7, []), "float"))
+        elif 3 in fields:
+            attrs[name] = fields[3][0]
+        elif 8 in fields:
+            attrs[name] = tuple(P.scalars(fields[8]))
+    return attrs
+
+
+def _parse_node(buf: bytes):
+    fields = P.collect(buf)
+    return {
+        "inputs": [b.decode() for b in fields.get(1, [])],
+        "outputs": [b.decode() for b in fields.get(2, [])],
+        "name": fields.get(3, [b""])[0].decode(),
+        "op_type": fields.get(4, [b""])[0].decode(),
+        "attrs": _parse_attrs(fields.get(5, [])),
+    }
+
+
+def _value_info_name(buf: bytes):
+    return P.collect(buf)[1][0].decode()
+
+
+def parse_model(path):
+    with open(path, "rb") as f:
+        raw = f.read()
+    try:
+        model = P.collect(raw)
+        graph = P.collect(model[7][0])
+    except (KeyError, IndexError, ValueError) as e:
+        raise MXNetError(
+            f"{path} is not a readable ONNX file (truncated or not in the "
+            f"supported subset): {e!r}") from e
+    nodes = [_parse_node(b) for b in graph.get(1, [])]
+    initializers = dict(_parse_tensor(b) for b in graph.get(5, []))
+    inputs = [_value_info_name(b) for b in graph.get(11, [])]
+    outputs = [_value_info_name(b) for b in graph.get(12, [])]
+    return nodes, initializers, inputs, outputs
+
+
+def _sym_pads(pads, nsp, op):
+    pads = tuple(int(v) for v in pads)
+    if not pads:
+        return (0,) * nsp
+    begin, end = pads[:nsp], pads[nsp:2 * nsp] or pads[:nsp]
+    if begin != end:
+        raise MXNetError(
+            f"ONNX import: asymmetric {op} padding {pads} is not supported")
+    return begin
+
+
+def _apply(op_name, sym_inputs, **attrs):
+    return Symbol.apply_op(op_name, *sym_inputs, **attrs)
+
+
+def _convert_node(n, env, params):
+    op = n["op_type"]
+    a = n["attrs"]
+    ins = [env[i] for i in n["inputs"] if i]
+
+    def const_of(name):
+        return params.get(name)
+
+    simple = {"Add": "add", "Sub": "subtract", "Mul": "multiply",
+              "Div": "true_divide", "MatMul": "matmul", "Relu": "relu",
+              "Sigmoid": "sigmoid", "Tanh": "tanh", "Exp": "exp",
+              "Log": "log", "Sqrt": "sqrt", "Abs": "abs", "Neg": "negative",
+              "Floor": "floor", "Ceil": "ceil", "Erf": "erf", "Pow": "power",
+              "Max": "maximum", "Min": "minimum", "Identity": "copy"}
+    if op in simple:
+        return _apply(simple[op], ins)
+    if op == "Softplus":
+        return Symbol.apply_op("activation", ins[0], act_type="softrelu")
+    if op == "Softsign":
+        return Symbol.apply_op("activation", ins[0], act_type="softsign")
+    if op == "Gemm":
+        x, w = ins[0], ins[1]
+        if int(a.get("transA", 0)):
+            x = Symbol.apply_op("transpose", x, axes=None)
+        if not int(a.get("transB", 0)):
+            # fully_connected expects (out, in): transpose untransposed B
+            w = Symbol.apply_op("transpose", w, axes=None)
+        alpha = float(a.get("alpha", 1.0))
+        beta = float(a.get("beta", 1.0))
+        out = Symbol.apply_op("fully_connected", x, w, no_bias=True,
+                              flatten=False)
+        if alpha != 1.0:
+            out = Symbol.apply_op("multiply", out, alpha)
+        if len(ins) > 2:
+            bias = ins[2]
+            if beta != 1.0:
+                bias = Symbol.apply_op("multiply", bias, beta)
+            out = Symbol.apply_op("add", out, bias)
+        return out
+    if op == "Flatten":
+        return _apply("flatten", ins)
+    if op == "Conv":
+        k = tuple(a.get("kernel_shape", ()))
+        pads = _sym_pads(a.get("pads", ()), len(k), op)
+        return Symbol.apply_op(
+            "convolution", *ins, kernel=k,
+            stride=tuple(a.get("strides", ())) or (1,) * len(k),
+            dilate=tuple(a.get("dilations", ())) or (1,) * len(k),
+            pad=pads or (0,) * len(k), num_group=a.get("group", 1),
+            no_bias=len(ins) < 3, num_filter=0)
+    if op in ("MaxPool", "AveragePool"):
+        k = tuple(a.get("kernel_shape", ()))
+        pads = _sym_pads(a.get("pads", ()), len(k), op)
+        return Symbol.apply_op(
+            "pooling", ins[0], kernel=k,
+            stride=tuple(a.get("strides", ())) or (1,) * len(k),
+            pad=pads or (0,) * len(k),
+            pool_type="max" if op == "MaxPool" else "avg",
+            ceil_mode=bool(a.get("ceil_mode", 0)),
+            count_include_pad=bool(a.get("count_include_pad", 1)))
+    if op in ("GlobalAveragePool", "GlobalMaxPool"):
+        return Symbol.apply_op(
+            "pooling", ins[0], kernel=(1, 1),
+            pool_type="avg" if "Average" in op else "max",
+            global_pool=True)
+    if op == "BatchNormalization":
+        out = Symbol.apply_op(
+            "batch_norm", *ins[:5], eps=float(a.get("epsilon", 1e-5)),
+            momentum=float(a.get("momentum", 0.9)), fix_gamma=False,
+            use_batch_stats=False, nout=3)
+        return out[0]
+    if op == "Softmax":
+        return Symbol.apply_op("softmax", ins[0],
+                               axis=int(a.get("axis", -1)))
+    if op == "LogSoftmax":
+        return Symbol.apply_op("log_softmax", ins[0],
+                               axis=int(a.get("axis", -1)))
+    if op == "LeakyRelu":
+        return Symbol.apply_op("leaky_relu", ins[0], act_type="leaky",
+                               slope=float(a.get("alpha", 0.01)))
+    if op == "Elu":
+        return Symbol.apply_op("leaky_relu", ins[0], act_type="elu",
+                               slope=float(a.get("alpha", 1.0)))
+    if op == "Reshape":
+        shape = const_of(n["inputs"][1])
+        if shape is None:
+            raise MXNetError("ONNX import: dynamic Reshape unsupported")
+        return Symbol.apply_op("reshape", ins[0],
+                               newshape=tuple(int(s) for s in shape))
+    if op == "Transpose":
+        perm = a.get("perm")
+        return Symbol.apply_op("transpose", ins[0],
+                               axes=tuple(perm) if perm else None)
+    if op == "Concat":
+        return Symbol.apply_op("concatenate", *ins,
+                               axis=int(a.get("axis", 0)))
+    if op == "Unsqueeze":
+        axes = const_of(n["inputs"][1])
+        out = ins[0]
+        for ax in sorted(int(v) for v in onp.asarray(axes).ravel()):
+            out = Symbol.apply_op("expand_dims", out, axis=ax)
+        return out
+    if op == "Squeeze":
+        if len(n["inputs"]) > 1:
+            axes = const_of(n["inputs"][1])
+            return Symbol.apply_op("squeeze", ins[0],
+                                   axis=tuple(int(s) for s in axes))
+        return Symbol.apply_op("squeeze", ins[0], axis=None)
+    if op == "Gather":
+        # (data, indices) -> our embedding order is (indices, weight)
+        if int(a.get("axis", 0)) == 0:
+            return Symbol.apply_op("embedding", ins[1], ins[0])
+        return Symbol.apply_op("take", ins[0], ins[1],
+                               axis=int(a.get("axis", 0)), mode="clip")
+    if op == "LayerNormalization":
+        return Symbol.apply_op("layer_norm", *ins,
+                               axis=int(a.get("axis", -1)),
+                               eps=float(a.get("epsilon", 1e-5)))
+    raise MXNetError(f"ONNX import: op {op!r} unsupported")
+
+
+def import_model(model_file):
+    """Load an .onnx file -> (SymbolBlock-ready symbol, params dict).
+
+    Returns (sym, arg_params, aux_params) like the reference importer.
+    """
+    nodes, initializers, inputs, outputs = parse_model(model_file)
+    from ...symbol.symbol import SymNode
+
+    env: dict[str, Symbol] = {}
+    for name in inputs:
+        env[name] = Symbol([(SymNode(name=name), 0)])
+    for name in initializers:
+        env[name] = Symbol([(SymNode(name=name), 0)])
+    for n in nodes:
+        out_sym = _convert_node(n, env, initializers)
+        env[n["outputs"][0]] = out_sym
+        for extra in n["outputs"][1:]:
+            env[extra] = out_sym  # aux outputs alias (BN etc.)
+    entries = []
+    for name in outputs:
+        entries.extend(env[name]._entries)
+    sym = Symbol(entries)
+    params = {k: NDArray(onp.ascontiguousarray(v))
+              for k, v in initializers.items()}
+    return sym, params, {}
+
+
+def import_to_gluon(model_file, input_names=None):
+    """Build a runnable SymbolBlock from an .onnx file. ``input_names``
+    (optional) renames the graph inputs in order."""
+    from ...gluon.block import SymbolBlock
+    from ...symbol.symbol import topo_sort
+
+    sym, params, _ = import_model(model_file)
+    var_names = [n.name for n in topo_sort(sym._entries)
+                 if n.is_var and n.name not in params]
+    if input_names:
+        names = [input_names] if isinstance(input_names, str)             else list(input_names)
+        if len(names) != len(var_names):
+            raise MXNetError(
+                f"input_names has {len(names)} entries for "
+                f"{len(var_names)} graph inputs ({var_names})")
+        for node in topo_sort(sym._entries):
+            if node.is_var and node.name in var_names:
+                node.name = names[var_names.index(node.name)]
+        var_names = names
+    return SymbolBlock(sym, var_names, params)

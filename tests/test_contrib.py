@@ -162,9 +162,66 @@ def axpy(x_ref, y_ref, o_ref):
         rtc.CudaModule("__global__ void k(float* x) {}")
 
 
-def test_onnx_gated():
-    from mxnet_tpu.contrib import onnx
+def test_onnx_mlp_roundtrip(tmp_path):
+    from mxnet_tpu.contrib import onnx as mxonnx
+    from mxnet_tpu.gluon import nn
 
-    if not onnx.HAS_ONNX:
-        with pytest.raises(MXNetError):
-            onnx.export_model(None, None)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    x = mx.np.random.uniform(size=(2, 8))
+    ref = net(x).asnumpy()
+    path = mxonnx.export_model(net, input_shape=(2, 8),
+                               onnx_file_path=str(tmp_path / "mlp.onnx"))
+    blk = mxonnx.import_to_gluon(path)
+    assert_almost_equal(blk(x), ref, rtol=1e-6, atol=1e-6)
+
+
+def test_onnx_convnet_roundtrip(tmp_path):
+    from mxnet_tpu.contrib import onnx as mxonnx
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+            nn.Activation("relu"), nn.MaxPool2D(2, 2), nn.Flatten(),
+            nn.Dense(3))
+    net.initialize()
+    x = mx.np.random.uniform(size=(1, 2, 8, 8))
+    ref = net(x).asnumpy()  # predict mode: BN uses running stats
+    path = mxonnx.export_model(net, input_shape=(1, 2, 8, 8),
+                               onnx_file_path=str(tmp_path / "conv.onnx"))
+    blk = mxonnx.import_to_gluon(path)
+    assert_almost_equal(blk(x), ref, rtol=1e-6, atol=1e-6)
+
+
+def test_onnx_symbol_export_and_import_model(tmp_path):
+    import mxnet_tpu.symbol as sym
+    from mxnet_tpu.contrib import onnx as mxonnx
+
+    a = sym.var("a")
+    w = sym.var("w")
+    out = sym.softmax(sym.FullyConnected(a, w, num_hidden=4, no_bias=True,
+                                         flatten=False))
+    wv = onp.random.randn(4, 6).astype("float32")
+    path = mxonnx.export_model(out, params={"w": wv},
+                               input_shape={"a": (3, 6)},
+                               onnx_file_path=str(tmp_path / "s.onnx"))
+    sym2, params, _ = mxonnx.import_model(path)
+    assert "w" in params
+    ex = sym2.bind(args={"a": mx.np.random.uniform(size=(3, 6)),
+                         "w": params["w"]})
+    assert ex.forward()[0].shape == (3, 4)
+
+
+def test_onnx_unsupported_op_errors(tmp_path):
+    from mxnet_tpu.cached_op import trace
+    from mxnet_tpu.contrib import onnx as mxonnx
+
+    x = np.array([[1.0, 2.0]])
+    _, _, cop = trace(lambda a: np.linalg.svd(a, full_matrices=False)[0],
+                      [x], [])
+    with pytest.raises(MXNetError):
+        mxonnx.export_model(cop.sym, params={},
+                            input_shape={"data0": (1, 2)},
+                            onnx_file_path=str(tmp_path / "bad.onnx"))
