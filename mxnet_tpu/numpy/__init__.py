@@ -738,3 +738,21 @@ def trim_zeros(filt, trim="fb"):
 
 def count_nonzero(a, axis=None):
     return sum(not_equal(_as_nd(a), 0).astype("int32"), axis=axis)
+
+
+def _norm_q(q):
+    qa = _onp.asarray(q.asnumpy() if isinstance(q, NDArray) else q,
+                      dtype="float64")
+    return float(qa) if qa.ndim == 0 else tuple(qa.tolist())
+
+
+def quantile(a, q, axis=None, out=None, overwrite_input=None,
+             interpolation="linear", keepdims=False):
+    return _op("quantile", _as_nd(a), q=_norm_q(q), axis=_ax(axis),
+               method=interpolation or "linear", keepdims=keepdims, out=out)
+
+
+def percentile(a, q, axis=None, out=None, overwrite_input=None,
+               interpolation="linear", keepdims=False):
+    return _op("percentile", _as_nd(a), q=_norm_q(q), axis=_ax(axis),
+               method=interpolation or "linear", keepdims=keepdims, out=out)

@@ -340,3 +340,12 @@ register("histogram_bounded", lambda bins=10, range=None:
          (lambda x: tuple(jnp.histogram(x, bins=bins, range=range))))
 register("corrcoef", lambda **a: jnp.corrcoef)
 register("cov", lambda **a: jnp.cov)
+
+register("quantile", lambda q=0.5, axis=None, keepdims=False,
+         method="linear":
+         (lambda x: jnp.quantile(x, jnp.asarray(q), axis=axis,
+                                 method=method, keepdims=keepdims)))
+register("percentile", lambda q=50.0, axis=None, keepdims=False,
+         method="linear":
+         (lambda x: jnp.percentile(x, jnp.asarray(q), axis=axis,
+                                   method=method, keepdims=keepdims)))

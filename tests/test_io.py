@@ -152,3 +152,33 @@ def test_im2rec_tool(tmp_path):
     assert os.path.exists(prefix + ".rec")
     r = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
     assert len(r.keys) == 6
+
+
+def test_batchify_stack_pad_tuple():
+    from mxnet_tpu.gluon.data import batchify
+
+    stacked = batchify.Stack()([onp.ones((2, 3)), onp.zeros((2, 3))])
+    assert stacked.shape == (2, 2, 3)
+    padded, lengths = batchify.Pad(axis=0, pad_val=-1, ret_length=True)(
+        [onp.ones(2), onp.ones(5)])
+    assert padded.shape == (2, 5)
+    assert padded.asnumpy()[0, 2:].tolist() == [-1.0, -1.0, -1.0]
+    assert lengths.asnumpy().tolist() == [2, 5]
+    pair = batchify.Tuple(batchify.Pad(pad_val=0), batchify.Stack())(
+        [(onp.ones(2), 0), (onp.ones(3), 1)])
+    assert pair[0].shape == (2, 3)
+    assert pair[1].asnumpy().tolist() == [0, 1]
+
+
+def test_batchify_with_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader, batchify
+
+    seqs = [onp.ones(i + 1, "float32") for i in range(8)]
+    labels = onp.arange(8, dtype="float32")
+    ds = ArrayDataset(seqs, labels)
+    loader = DataLoader(ds, batch_size=4,
+                        batchify_fn=batchify.Tuple(
+                            batchify.Pad(pad_val=0), batchify.Stack()))
+    batches = list(loader)
+    assert batches[0][0].shape == (4, 4)
+    assert batches[1][0].shape == (4, 8)
