@@ -52,6 +52,8 @@ class Trainer:
         self._kv_initialized = False
         self._kvstore_spec = kvstore
         self._scale = self._optimizer.rescale_grad
+        self._fused_fn = None
+        self._fused_sig = None
 
     # -- properties ---------------------------------------------------------
     @property
@@ -122,6 +124,9 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore and self._kvstore is not None:
+            return  # optimizer ran on the store during pushpull
+        active = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
@@ -129,13 +134,62 @@ class Trainer:
                 if ignore_stale_grad:
                     continue
                 raise MXNetError(f"parameter {p.name} not initialized")
-            if self._update_on_kvstore and self._kvstore is not None:
-                # optimizer ran on the store during pushpull
-                continue
             if self._states[i] is None:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(i, p.data())
+            active.append(i)
+        if self._try_fused_update(active):
+            return
+        for i in active:
+            p = self._params[i]
             self._optimizer.update(i, p.data(), p.grad(), self._states[i])
+
+    def _try_fused_update(self, active) -> bool:
+        """Update ALL parameters in ONE jitted program (reference: the
+        multi_sgd/multi_adam fused kernels). Collapses per-param dispatch
+        overhead — decisive when each dispatch pays remote-tunnel latency.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        opt = self._optimizer
+        fusable = getattr(opt, "_fusable", None)
+        if fusable is None or opt.multi_precision or not active:
+            return False
+        raw, state_keys, needs_t = fusable
+        if self._fused_fn is None or self._fused_sig != tuple(active):
+            n_state = len(state_keys)
+
+            def multi_step(ws, ss, gs, lrs, wds, ts, rs):
+                new_ws, new_ss = [], []
+                for w, s, g, lr, wd, t in zip(ws, ss, gs, lrs, wds, ts):
+                    g = g * rs
+                    args = [w, *s, g, lr, wd] + ([t] if needs_t else [])
+                    out = raw(*args)
+                    if n_state:
+                        new_ws.append(out[0])
+                        new_ss.append(tuple(out[1:]))
+                    else:
+                        new_ws.append(out)
+                        new_ss.append(())
+                return new_ws, new_ss
+
+            self._fused_fn = jax.jit(multi_step, donate_argnums=(0, 1))
+            self._fused_sig = tuple(active)
+        ws = [self._params[i].data()._data for i in active]
+        ss = [tuple(self._states[i][k]._data for k in state_keys)
+              for i in active]
+        gs = [self._params[i].grad()._data for i in active]
+        ts = [jnp.float32(opt._update_count(i)) for i in active]
+        lrs = [jnp.float32(opt._get_lr(i)) for i in active]
+        wds = [jnp.float32(opt._get_wd(i)) for i in active]
+        rs = jnp.float32(opt.rescale_grad)
+        new_ws, new_ss = self._fused_fn(ws, ss, gs, lrs, wds, ts, rs)
+        for idx, i in enumerate(active):
+            self._params[i].data()._set_data(new_ws[idx])
+            for k, arr in zip(state_keys, new_ss[idx]):
+                self._states[i][k]._set_data(arr)
+        return True
 
     def update(self, batch_size, ignore_stale_grad=False):
         """Apply updates without allreduce (manual grad management)."""

@@ -160,8 +160,15 @@ class Optimizer:
 
 
 def _jit_step(fn, n_donate):
-    """jit with weight+state buffers donated (in-place HBM update)."""
-    return jax.jit(fn, donate_argnums=tuple(range(n_donate)))
+    """jit with weight+state buffers donated (in-place HBM update).
+
+    The raw closure is kept on the jitted fn (``.raw``) so Trainer can
+    fuse MANY parameters' updates into one program (reference: the
+    multi_sgd/multi_*_update fused kernels, optimizer_op.cc:49-1044).
+    """
+    jitted = jax.jit(fn, donate_argnums=tuple(range(n_donate)))
+    jitted.raw = fn
+    return jitted
 
 
 _rescale_jit = jax.jit(lambda g, r: g * r)
@@ -190,6 +197,11 @@ class SGD(Optimizer):
 
         self._step = _jit_step(step, 2)
         self._step_nomom = _jit_step(step_nomom, 1)
+        # fused multi-tensor layout (Trainer): raw fn, state keys, needs_t
+        if momentum == 0.0:
+            self._fusable = (step_nomom, (), False)
+        else:
+            self._fusable = (step, ("mom",), False)
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -257,6 +269,7 @@ class _AdamBase(Optimizer):
             return (wf - lr * upd).astype(w.dtype), m, v
 
         self._step = _jit_step(step, 3)
+        self._fusable = (step, ("mean", "var"), True)
 
     def create_state(self, index, weight):
         return {"mean": NDArray(jnp.zeros(weight.shape, jnp.float32)),
