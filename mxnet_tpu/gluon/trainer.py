@@ -52,8 +52,7 @@ class Trainer:
         self._kv_initialized = False
         self._kvstore_spec = kvstore
         self._scale = self._optimizer.rescale_grad
-        self._fused_fn = None
-        self._fused_sig = None
+        self._fused_fn = None  # {active-param tuple: jitted multi-step}
 
     # -- properties ---------------------------------------------------------
     @property
@@ -124,8 +123,6 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        if self._update_on_kvstore and self._kvstore is not None:
-            return  # optimizer ran on the store during pushpull
         active = []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
@@ -138,6 +135,8 @@ class Trainer:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(i, p.data())
             active.append(i)
+        if self._update_on_kvstore and self._kvstore is not None:
+            return  # optimizer ran on the store during pushpull
         if self._try_fused_update(active):
             return
         for i in active:
@@ -150,14 +149,17 @@ class Trainer:
         overhead — decisive when each dispatch pays remote-tunnel latency.
         """
         import jax
-        import jax.numpy as jnp
 
         opt = self._optimizer
         fusable = getattr(opt, "_fusable", None)
         if fusable is None or opt.multi_precision or not active:
             return False
+        import numpy as onp
+
         raw, state_keys, needs_t = fusable
-        if self._fused_fn is None or self._fused_sig != tuple(active):
+        key = tuple(active)
+        fused = self._fused_fn.get(key) if self._fused_fn else None
+        if fused is None:
             n_state = len(state_keys)
 
             def multi_step(ws, ss, gs, lrs, wds, ts, rs):
@@ -174,17 +176,21 @@ class Trainer:
                         new_ss.append(())
                 return new_ws, new_ss
 
-            self._fused_fn = jax.jit(multi_step, donate_argnums=(0, 1))
-            self._fused_sig = tuple(active)
+            fused = jax.jit(multi_step, donate_argnums=(0, 1))
+            if self._fused_fn is None:
+                self._fused_fn = {}
+            self._fused_fn[key] = fused  # keep compiled variants per subset
         ws = [self._params[i].data()._data for i in active]
         ss = [tuple(self._states[i][k]._data for k in state_keys)
               for i in active]
         gs = [self._params[i].grad()._data for i in active]
-        ts = [jnp.float32(opt._update_count(i)) for i in active]
-        lrs = [jnp.float32(opt._get_lr(i)) for i in active]
-        wds = [jnp.float32(opt._get_wd(i)) for i in active]
-        rs = jnp.float32(opt.rescale_grad)
-        new_ws, new_ss = self._fused_fn(ws, ss, gs, lrs, wds, ts, rs)
+        # host numpy scalars: the jit call bundles them in ONE transfer
+        # (per-scalar device_put would reintroduce O(N) round trips)
+        ts = [onp.float32(opt._update_count(i)) for i in active]
+        lrs = [onp.float32(opt._get_lr(i)) for i in active]
+        wds = [onp.float32(opt._get_wd(i)) for i in active]
+        rs = onp.float32(opt.rescale_grad)
+        new_ws, new_ss = fused(ws, ss, gs, lrs, wds, ts, rs)
         for idx, i in enumerate(active):
             self._params[i].data()._set_data(new_ws[idx])
             for k, arr in zip(state_keys, new_ss[idx]):
