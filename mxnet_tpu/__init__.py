@@ -23,7 +23,8 @@ __version__ = "0.1.0"
 
 from .base import MXNetError
 from .context import (Context, Device, cpu, cpu_pinned, gpu, tpu, device,
-                      current_context, current_device, num_gpus, num_tpus)
+                      current_context, current_device, num_gpus, num_tpus,
+                      tpu_memory_info, gpu_memory_info)
 from . import engine
 from . import ops
 from .ndarray.ndarray import NDArray, array, from_jax

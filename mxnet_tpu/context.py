@@ -170,6 +170,21 @@ def num_gpus() -> int:
     return num_tpus()
 
 
+def tpu_memory_info(device_id: int = 0):
+    """(free, total) HBM bytes for a local chip (reference:
+    mx.context.gpu_memory_info over cudaMemGetInfo)."""
+    import jax
+
+    dev = tpu(device_id).jax_device()
+    stats = dev.memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return total - used, total
+
+
+gpu_memory_info = tpu_memory_info  # legacy-script alias
+
+
 def num_tpus() -> int:
     import jax
 

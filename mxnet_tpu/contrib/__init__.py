@@ -3,5 +3,6 @@ from . import text
 from . import quantization
 from . import onnx
 from . import tensorboard
+from . import tensorrt
 
-__all__ = ["text", "quantization", "onnx", "tensorboard"]
+__all__ = ["text", "quantization", "onnx", "tensorboard", "tensorrt"]

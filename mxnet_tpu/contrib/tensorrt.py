@@ -1,0 +1,26 @@
+"""TensorRT integration (reference: python/mxnet/contrib/tensorrt.py over
+src/operator/subgraph/tensorrt/).
+
+Not applicable on TPU: TensorRT is a CUDA inference runtime. The equivalent
+deployment paths here are (a) hybridize — the whole graph compiles to one
+XLA program, which IS the optimized inference engine on TPU — and
+(b) contrib.onnx export for external runtimes. These entry points exist so
+legacy scripts fail with guidance instead of AttributeError.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["init_tensorrt_params", "get_optimized_symbol"]
+
+_MSG = ("TensorRT is CUDA-specific and has no TPU analog; use "
+        "net.hybridize() (XLA whole-graph compilation) or "
+        "mx.contrib.onnx.export_model for external runtimes")
+
+
+def init_tensorrt_params(sym, arg_params, aux_params):
+    raise MXNetError(_MSG)
+
+
+def get_optimized_symbol(executor):
+    raise MXNetError(_MSG)
