@@ -28,6 +28,8 @@ __all__ = [
     "current_device",
     "num_gpus",
     "num_tpus",
+    "tpu_memory_info",
+    "gpu_memory_info",
 ]
 
 _DEVTYPES = ("cpu", "tpu", "cpu_pinned", "cpu_shared", "gpu")
@@ -170,19 +172,28 @@ def num_gpus() -> int:
     return num_tpus()
 
 
-def tpu_memory_info(device_id: int = 0):
-    """(free, total) HBM bytes for a local chip (reference:
-    mx.context.gpu_memory_info over cudaMemGetInfo)."""
-    import jax
-
-    dev = tpu(device_id).jax_device()
-    stats = dev.memory_stats() or {}
+def _memory_info(ctx):
+    dev = ctx.jax_device()
+    stats = dev.memory_stats()
+    if not stats:
+        raise MXNetError(
+            f"device {dev} reports no memory statistics (backend without "
+            "memory_stats support)")
     total = stats.get("bytes_limit", 0)
     used = stats.get("bytes_in_use", 0)
     return total - used, total
 
 
-gpu_memory_info = tpu_memory_info  # legacy-script alias
+def tpu_memory_info(device_id: int = 0):
+    """(free, total) HBM bytes for a local chip (reference:
+    mx.context.gpu_memory_info over cudaMemGetInfo)."""
+    return _memory_info(tpu(device_id))
+
+
+def gpu_memory_info(device_id: int = 0):
+    """Legacy alias resolving through the gpu() platform alias (so plugin
+    accelerator platforms behave the same as mx.gpu() placements)."""
+    return _memory_info(gpu(device_id))
 
 
 def num_tpus() -> int:

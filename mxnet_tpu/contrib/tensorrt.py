@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["init_tensorrt_params", "get_optimized_symbol"]
+__all__ = ["init_tensorrt_params", "get_optimized_symbol",
+           "set_use_fp16", "get_use_fp16"]
 
 _MSG = ("TensorRT is CUDA-specific and has no TPU analog; use "
         "net.hybridize() (XLA whole-graph compilation) or "
@@ -23,4 +24,12 @@ def init_tensorrt_params(sym, arg_params, aux_params):
 
 
 def get_optimized_symbol(executor):
+    raise MXNetError(_MSG)
+
+
+def set_use_fp16(status):
+    raise MXNetError(_MSG)
+
+
+def get_use_fp16():
     raise MXNetError(_MSG)
