@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Run a test repeatedly to measure flakiness (reference:
+tools/flakiness_checker.py).
+
+    python tools/flakiness_checker.py tests/test_optimizer.py::test_x -n 20
+"""
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("test", help="pytest node id")
+    ap.add_argument("-n", "--trials", type=int, default=20)
+    ap.add_argument("--stop-on-fail", action="store_true")
+    args = ap.parse_args()
+
+    failures = 0
+    for trial in range(args.trials):
+        res = subprocess.run(
+            [sys.executable, "-m", "pytest", args.test, "-q", "-x"],
+            capture_output=True, text=True)
+        ok = res.returncode == 0
+        print(f"trial {trial + 1}/{args.trials}: "
+              f"{'PASS' if ok else 'FAIL'}", flush=True)
+        if not ok:
+            failures += 1
+            tail = "\n".join(res.stdout.strip().splitlines()[-12:])
+            print(tail, flush=True)
+            if args.stop_on_fail:
+                break
+    print(f"\n{failures}/{trial + 1} trials failed "
+          f"({100.0 * failures / (trial + 1):.1f}%)")
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
