@@ -229,9 +229,10 @@ def _batch_norm(eps=1e-5, momentum=0.9, fix_gamma=True, use_batch_stats=True,
                 axis=1):
     def f(x, gamma, beta, moving_mean, moving_var):
         g = jnp.ones_like(gamma) if fix_gamma else gamma
-        red = tuple(i for i in range(x.ndim) if i != axis)
+        ax = axis if axis >= 0 else x.ndim + axis  # normalize negative axis
+        red = tuple(i for i in range(x.ndim) if i != ax)
         shape = [1] * x.ndim
-        shape[axis] = x.shape[axis]
+        shape[ax] = x.shape[ax]
         if use_batch_stats:
             mean = jnp.mean(x, axis=red)
             var = jnp.var(x, axis=red)

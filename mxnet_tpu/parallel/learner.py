@@ -186,3 +186,51 @@ class Learner:
         for target, data in zip(self._aux_targets, aux):
             target._set_data(data)
         return NDArray(loss_v)
+
+
+    # -- checkpointing (reference analog: Trainer.save_states +
+    # Block.save_parameters; SURVEY §5.4 'orbax-style sharded checkpoint
+    # with the same logical naming') ------------------------------------
+    def _checkpoint_tree(self):
+        """Single source of the checkpoint pytree: trainable params,
+        NON-trainable state (BN running stats etc.), optimizer state."""
+        if self._params is None:
+            raise MXNetError("Learner has not traced yet — run a step "
+                             "before checkpoint operations (shapes and "
+                             "shardings come from the live state)")
+        aux = {n: p.data()._data
+               for n, p in self.net.collect_params().items()
+               if p.grad_req == "null" and p._data is not None}
+        return {
+            "params": {n: self._params[n].data()._data
+                       for n in self._param_names},
+            "aux": aux,
+            "opt_state": self._opt_state,
+        }
+
+    def save_checkpoint(self, directory):
+        """Write params + aux + optimizer state with their shardings via
+        orbax; each host writes its own shards, so multi-host checkpoints
+        scale."""
+        import os
+
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as saver:
+            saver.save(os.path.abspath(directory), self._checkpoint_tree(),
+                       force=True)
+
+    def restore_checkpoint(self, directory):
+        import os
+
+        import orbax.checkpoint as ocp
+
+        template = self._checkpoint_tree()
+        with ocp.StandardCheckpointer() as loader:
+            restored = loader.restore(os.path.abspath(directory), template)
+        for n in self._param_names:
+            self._params[n].data()._set_data(restored["params"][n])
+        all_params = self.net.collect_params()
+        for n, arr in restored["aux"].items():
+            all_params[n].data()._set_data(arr)
+        self._opt_state = restored["opt_state"]
