@@ -85,7 +85,7 @@ def main():
     ap.add_argument("--table", action="store_true")
     args = ap.parse_args()
 
-    import jax
+    from mxnet_tpu.context import default_backend
 
     specs = op_specs(args.size)
     names = args.ops.split(",") if args.ops else sorted(specs)
@@ -101,7 +101,7 @@ def main():
             print(f"# {name} failed: {e}", file=sys.stderr)
             continue
         results.append({"op": name, "avg_time_ms": round(dt * 1e3, 4),
-                        "backend": jax.default_backend(),
+                        "backend": default_backend(),
                         "size": args.size})
     if args.table:
         print(f"{'op':<20}{'avg ms':>12}")

@@ -24,6 +24,7 @@ __all__ = [
     "gpu",
     "tpu",
     "device",
+    "default_backend",
     "current_context",
     "current_device",
     "num_gpus",
@@ -58,9 +59,7 @@ class Context:
         if self.device_type == "tpu":
             return "tpu"
         # 'gpu' alias: whatever the default accelerator platform is
-        import jax
-
-        plat = jax.default_backend()
+        plat = default_backend()
         return plat if plat != "cpu" else "cpu"
 
     def jax_device(self):
@@ -150,11 +149,137 @@ def device(dev: str | Context | None = None, device_id: int = 0) -> Context:
     raise MXNetError(f"cannot interpret {dev!r} as a device")
 
 
+_probe_cache = {"backend": None}
+
+
+def _subprocess_backend_probe(timeout_s: float) -> tuple[str | None, bool]:
+    """Ask a child interpreter which backend jax resolves to.
+
+    TPU runtime setup can hang or die inside ``jax.default_backend()``
+    (PJRT plugin dial-out); probing in a subprocess keeps the parent's
+    backend state untouched so we can still fall back to a working CPU
+    runtime — once ``xla_bridge.backends()`` has started in-process there
+    is no clean way to abort it.
+
+    Returns ``(backend_name_or_None, timed_out)``.
+    """
+    import subprocess
+    import sys
+
+    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None, True
+    except OSError:
+        return None, False
+    if out.returncode != 0:
+        return None, False
+    for line in reversed(out.stdout.strip().splitlines()):
+        if line.startswith("BACKEND="):
+            return line[len("BACKEND="):], False
+    return None, False
+
+
+def default_backend() -> str:
+    """``jax.default_backend()`` hardened against accelerator-runtime
+    init failure (reference analog: MXNet degrades to CPU context when
+    CUDA init fails rather than aborting the process).
+
+    Strategy: if a platform is already forced (``jax_platforms``) or the
+    backends are already live, call through directly. Otherwise probe in
+    a subprocess under ``MXTPU_BACKEND_PROBE_TIMEOUT_S`` (default 300 s,
+    generous for tunneled-TPU first contact), retry once, and on failure
+    pin this process to CPU *before* any in-process backend init so the
+    framework keeps working, loudly.
+    """
+    if _probe_cache["backend"] is not None:
+        return _probe_cache["backend"]
+    import os
+    import warnings
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    forced = getattr(jax.config, "jax_platforms", None) or \
+        os.environ.get("JAX_PLATFORMS") or ""
+    # direct call is safe only when backends are already live or the forced
+    # platform list is pure-CPU. A plugin-register site hook may itself set
+    # jax_platforms to "<accel>,cpu" — that still hangs if the accelerator
+    # runtime is dead, so it does NOT qualify for the fast path.
+    cpu_only = bool(forced) and \
+        all(p.strip() == "cpu" for p in forced.split(",") if p.strip())
+    if cpu_only and getattr(jax.config, "jax_platforms", None) != forced:
+        try:  # make an env-only restriction stick in the live config
+            jax.config.update("jax_platforms", forced)
+        except Exception:
+            pass
+    live = bool(getattr(_xb, "_backends", None))
+    if cpu_only or live:
+        try:
+            b = jax.default_backend()
+        except RuntimeError as e:
+            warnings.warn(
+                f"accelerator backend init failed ({e}); falling back to "
+                "CPU. Set JAX_PLATFORMS explicitly to silence.",
+                RuntimeWarning, stacklevel=2)
+            b = "cpu"
+        _probe_cache["backend"] = b
+        return b
+
+    if os.environ.get("MXTPU_SKIP_BACKEND_PROBE", "") == "1":
+        # operator asserts the accelerator runtime is healthy: skip the
+        # child-process round trip (saves one full backend init)
+        try:
+            b = jax.default_backend()
+        except RuntimeError:
+            b = "cpu"
+        _probe_cache["backend"] = b
+        return b
+    timeout_s = float(os.environ.get("MXTPU_BACKEND_PROBE_TIMEOUT_S", "300"))
+    probed, timed_out = _subprocess_backend_probe(timeout_s)
+    if probed is None and not timed_out:
+        # fast nonzero-exit failures can be transient tunnel hiccups —
+        # retry once; a TIMEOUT is a deterministic hang, don't double it
+        probed, timed_out = _subprocess_backend_probe(timeout_s)
+    if probed is None or probed == "cpu":
+        if probed is None:
+            warnings.warn(
+                "accelerator backend probe "
+                + ("timed out" if timed_out else "failed twice")
+                + f" (budget {timeout_s:.0f}s); pinning this process to "
+                "CPU. Set MXTPU_BACKEND_PROBE_TIMEOUT_S or JAX_PLATFORMS "
+                "to override.", RuntimeWarning, stacklevel=2)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        probed = "cpu"
+    # the child proved this platform initializes; resolve it in-process
+    try:
+        b = jax.default_backend()
+    except RuntimeError as e:
+        warnings.warn(
+            f"accelerator backend init failed in-process ({e}) after a "
+            "successful probe; falling back to CPU.",
+            RuntimeWarning, stacklevel=2)
+        b = "cpu"
+    _probe_cache["backend"] = b
+    return b
+
+
+def _is_tpu_platform(name: str) -> bool:
+    """True for TPU-family platforms. PJRT TPU plugins may register under a
+    vendor name (e.g. a tunneled plugin) while canonicalizing to TPU, so
+    anything that is not a known host/GPU platform counts as TPU."""
+    return name not in ("cpu", "gpu", "cuda", "rocm", "METAL")
+
+
 def default_context() -> Context:
     """The default device: TPU if the runtime has one, else CPU."""
-    import jax
-
-    return tpu(0) if jax.default_backend() == "tpu" else cpu(0)
+    return tpu(0) if _is_tpu_platform(default_backend()) else cpu(0)
 
 
 def current_context() -> Context:

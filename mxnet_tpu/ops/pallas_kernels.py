@@ -32,8 +32,10 @@ _NEG_INF = -1e30
 
 
 def _on_tpu() -> bool:
+    from ..context import _is_tpu_platform, default_backend
+
     try:
-        return jax.default_backend() == "tpu"
+        return _is_tpu_platform(default_backend())
     except RuntimeError:
         return False
 

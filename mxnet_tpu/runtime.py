@@ -15,10 +15,10 @@ class Feature:
 
 
 def _detect():
-    import jax
+    from .context import _is_tpu_platform, default_backend
 
     feats = {
-        "TPU": jax.default_backend() == "tpu",
+        "TPU": _is_tpu_platform(default_backend()),
         "XLA": True,
         "PJRT": True,
         "PALLAS": True,
