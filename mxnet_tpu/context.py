@@ -183,6 +183,38 @@ def _subprocess_backend_probe(timeout_s: float) -> tuple[str | None, bool]:
     return None, False
 
 
+def _probe_marker_path():
+    import os
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(),
+                        f".mxtpu_backend_ok_{os.getuid()}")
+
+
+def _probe_marker_fresh() -> bool:
+    """A recent successful accelerator init (any process) lets fresh
+    processes skip the subprocess probe. TTL-bounded: a runtime that died
+    inside the window can still hang us, so keep the window short."""
+    import os
+    import time
+
+    ttl = float(os.environ.get("MXTPU_PROBE_CACHE_TTL_S", "600"))
+    if ttl <= 0:
+        return False
+    try:
+        return (time.time() - os.stat(_probe_marker_path()).st_mtime) < ttl
+    except OSError:
+        return False
+
+
+def _write_probe_marker():
+    try:
+        with open(_probe_marker_path(), "w") as fh:
+            fh.write("ok\n")
+    except OSError:
+        pass
+
+
 def default_backend() -> str:
     """``jax.default_backend()`` hardened against accelerator-runtime
     init failure (reference analog: MXNet degrades to CPU context when
@@ -229,13 +261,18 @@ def default_backend() -> str:
         _probe_cache["backend"] = b
         return b
 
-    if os.environ.get("MXTPU_SKIP_BACKEND_PROBE", "") == "1":
-        # operator asserts the accelerator runtime is healthy: skip the
-        # child-process round trip (saves one full backend init)
+    if os.environ.get("MXTPU_SKIP_BACKEND_PROBE", "") == "1" \
+            or _probe_marker_fresh():
+        # operator asserts the runtime is healthy (env var), or another
+        # process proved it recently (marker file): skip the child-process
+        # round trip — a full duplicate backend init (~20-40s of TPU first
+        # contact) — and init in-process directly
         try:
             b = jax.default_backend()
         except RuntimeError:
             b = "cpu"
+        if _is_tpu_platform(b):
+            _write_probe_marker()  # refresh the health window
         _probe_cache["backend"] = b
         return b
     timeout_s = float(os.environ.get("MXTPU_BACKEND_PROBE_TIMEOUT_S", "300"))
@@ -266,6 +303,8 @@ def default_backend() -> str:
             "successful probe; falling back to CPU.",
             RuntimeWarning, stacklevel=2)
         b = "cpu"
+    if _is_tpu_platform(b):
+        _write_probe_marker()
     _probe_cache["backend"] = b
     return b
 

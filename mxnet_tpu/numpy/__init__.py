@@ -23,6 +23,8 @@ from ..ops.registry import apply_op as _op
 from ..ops import indexing as _indexing
 from .. import random  # noqa: F401 — mx.np.random
 from . import linalg  # noqa: F401
+from ._serialization import (save, savez, savez_compressed,  # noqa: F401
+                             load)
 
 ndarray = NDArray
 

@@ -1,0 +1,79 @@
+"""mx.np.save / load / savez — numpy .npy/.npz wire-format interchange.
+
+TPU-native counterpart of the reference's cnpy codec
+(src/serialization/cnpy.cc:896, surfaced as mx.np.save/load in
+python/mxnet/numpy/utils.py). The device side is JAX arrays in HBM, so
+serialization is a host concern: arrays are fetched (wait + device→host copy)
+and written with numpy's own writer, which *is* the wire format — files
+round-trip bit-exactly with stock ``numpy.load``.
+
+bfloat16 policy: ml_dtypes' bfloat16 has no portable .npy descr (stock numpy
+reads it back as ``|V2`` raw bytes), so by default bfloat16 arrays are saved
+as float32 — the upcast is value-exact and the file loads everywhere. Set
+``MXTPU_NPY_BF16=raw`` to keep the 2-byte payload (readers then need
+ml_dtypes to reinterpret). The chosen policy only affects dtype width on
+disk, never values.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as _onp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["save", "savez", "savez_compressed", "load"]
+
+
+def _to_host(a):
+    if isinstance(a, NDArray):
+        a = a.asnumpy()
+    a = _onp.asarray(a)
+    if a.dtype.name == "bfloat16" and \
+            os.environ.get("MXTPU_NPY_BF16", "float32") != "raw":
+        a = a.astype(_onp.float32)
+    return a
+
+
+def save(file, arr):
+    """Write one array as .npy (numpy wire format, numpy.load-compatible)."""
+    _onp.save(file, _to_host(arr))
+
+
+def savez(file, *args, **kwds):
+    """Write arrays as an uncompressed .npz archive."""
+    _onp.savez(file, *[_to_host(a) for a in args],
+               **{k: _to_host(v) for k, v in kwds.items()})
+
+
+def savez_compressed(file, *args, **kwds):
+    """Write arrays as a zip-deflated .npz archive."""
+    _onp.savez_compressed(file, *[_to_host(a) for a in args],
+                          **{k: _to_host(v) for k, v in kwds.items()})
+
+
+def load(file, allow_pickle=False):
+    """Read .npy → NDArray, or .npz → dict of name → NDArray.
+
+    Object arrays are refused by default like numpy's own loader; device
+    placement follows the current context (lazy, on first use).
+    """
+    data = _onp.load(file, allow_pickle=allow_pickle)
+    if isinstance(data, _onp.lib.npyio.NpzFile):
+        try:
+            return {k: NDArray(_decode(data[k])) for k in data.files}
+        finally:
+            data.close()
+    return NDArray(_decode(data))
+
+
+def _decode(a):
+    if a.dtype.kind == "V" and a.dtype.itemsize == 2:
+        # raw-mode bfloat16 payload (see module docstring)
+        import ml_dtypes
+
+        return a.view(_onp.uint16).view(ml_dtypes.bfloat16)
+    if a.dtype == _onp.object_:
+        raise MXNetError("object arrays are not loadable as NDArray")
+    return a

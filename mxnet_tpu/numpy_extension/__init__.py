@@ -314,3 +314,60 @@ def custom(*inputs, op_type, **kwargs):
 
 # control flow lowered to lax.scan/while/cond lives in .control_flow
 from .control_flow import foreach, while_loop, cond  # noqa: E402,F401
+
+
+# -- detection / vision ops (ops/vision.py; reference contrib/bounding_box.cc,
+#    roi_pooling.cc, roi_align.cc, nn/upsampling.cc, bilinear_resize.cc) -----
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    return _op("box_iou", _nd(lhs), _nd(rhs), format=format)
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner", out_format="corner"):
+    return _op("box_nms", _nd(data), overlap_thresh=overlap_thresh,
+               valid_thresh=valid_thresh, topk=topk, coord_start=coord_start,
+               score_index=score_index, id_index=id_index,
+               background_id=background_id, force_suppress=force_suppress,
+               in_format=in_format, out_format=out_format)
+
+
+def box_encode(samples, matches, anchors, refs,
+               means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2)):
+    return _op("box_encode", _nd(samples), _nd(matches), _nd(anchors),
+               _nd(refs), means=tuple(means), stds=tuple(stds))
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="center"):  # noqa: A002
+    return _op("box_decode", _nd(data), _nd(anchors), std0=std0, std1=std1,
+               std2=std2, std3=std3, clip=clip, format=format)
+
+
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    return _op("roi_pooling", _nd(data), _nd(rois),
+               pooled_size=tuple(pooled_size), spatial_scale=spatial_scale)
+
+
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=2, aligned=False):
+    return _op("roi_align", _nd(data), _nd(rois),
+               pooled_size=tuple(pooled_size), spatial_scale=spatial_scale,
+               sample_ratio=sample_ratio, aligned=aligned)
+
+
+def upsampling(data, scale=2, sample_type="nearest"):
+    return _op("upsampling", _nd(data), scale=scale, sample_type=sample_type)
+
+
+def bilinear_resize_2d(data, height=0, width=0, scale_height=None,
+                       scale_width=None, align_corners=True):
+    return _op("bilinear_resize_2d", _nd(data), height=height, width=width,
+               scale_height=scale_height, scale_width=scale_width,
+               align_corners=align_corners)
+
+
+def moments(data, axes=None, keepdims=False):
+    return _op("moments", _nd(data),
+               axes=tuple(axes) if axes is not None else None,
+               keepdims=keepdims)

@@ -4,5 +4,6 @@ from . import _core  # noqa: F401 — registers elemwise/reduce/shape/linalg ops
 from . import nn  # noqa: F401 — registers NN ops
 from . import indexing  # noqa: F401 — registers slice/scatter ops
 from . import rnn  # noqa: F401 — registers the fused scan RNN op
+from . import vision  # noqa: F401 — registers detection/resize/ROI ops
 
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke", "apply_op"]

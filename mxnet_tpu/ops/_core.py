@@ -47,7 +47,7 @@ _UNARY = {
     "ceil": jnp.ceil,
     "trunc": jnp.trunc,
     "rint": jnp.rint,
-    "fix": jnp.fix,
+    "fix": jnp.trunc,
     "invert": jnp.invert,
     "logical_not": jnp.logical_not,
     "isnan": jnp.isnan,

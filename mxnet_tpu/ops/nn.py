@@ -326,6 +326,11 @@ def _activation(act_type="relu"):
         "softsign": jax.nn.soft_sign,
         "log_sigmoid": jax.nn.log_sigmoid,
         "mish": jax.nn.mish,
+        # reference HardSigmoid (leaky_relu.cc): clip(0.2*x + 0.5, 0, 1) —
+        # NOT jax.nn.hard_sigmoid, whose slope is 1/6
+        "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+        "hard_swish": jax.nn.hard_swish,
+        "silu": jax.nn.silu,
     }
     if act_type not in table:
         raise MXNetError(f"unknown activation {act_type!r}")

@@ -169,3 +169,38 @@ def test_dlpack_numpy_interop():
     a = np.array([1.0, 2.0])
     arr = onp.asarray(a)
     assert arr.tolist() == [1.0, 2.0]
+
+
+def test_np_save_load_npy_roundtrip(tmp_path):
+    """mx.np.save writes real .npy: bit-exact with stock numpy.load."""
+    # float64 omitted: framework canonicalizes to float32 (x64 disabled)
+    for dt in (onp.float32, onp.int32, onp.uint8, onp.bool_):
+        a = np.array(onp.arange(12).reshape(3, 4).astype(dt))
+        f = str(tmp_path / f"a_{onp.dtype(dt).name}.npy")
+        np.save(f, a)
+        ref = onp.load(f)  # stock numpy reads our file
+        assert ref.dtype == onp.dtype(dt)
+        assert ref.tobytes() == a.asnumpy().tobytes()
+        back = np.load(f)
+        assert back.asnumpy().tobytes() == a.asnumpy().tobytes()
+
+
+def test_np_save_load_bfloat16_policy(tmp_path):
+    """Default policy: bf16 saved as float32 (value-exact, portable)."""
+    a = np.ones((4, 4), dtype="bfloat16") * 1.5
+    f = str(tmp_path / "bf16.npy")
+    np.save(f, a)
+    ref = onp.load(f)
+    assert ref.dtype == onp.float32
+    assert (ref == 1.5).all()
+
+
+def test_np_savez_roundtrip(tmp_path):
+    f = str(tmp_path / "z.npz")
+    np.savez(f, w=np.ones((2, 3)), b=np.zeros((3,)))
+    d = np.load(f)
+    assert set(d) == {"w", "b"}
+    assert d["w"].shape == (2, 3)
+    z = onp.load(f)  # interchange with stock numpy
+    assert z["b"].shape == (3,)
+    z.close()

@@ -1,0 +1,201 @@
+"""Detection / vision op tier tests (reference oracle:
+tests/python/unittest/test_contrib_operator.py test_box_nms/test_bbox_iou,
+test_operator.py test_roipooling/test_bilinear_resize/test_moments)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _iou_ref(a, b):
+    x1 = max(a[0], b[0]); y1 = max(a[1], b[1])
+    x2 = min(a[2], b[2]); y2 = min(a[3], b[3])
+    inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_box_iou_matches_reference():
+    a = onp.random.uniform(0, 1, (5, 4)).astype(onp.float32)
+    b = onp.random.uniform(0, 1, (7, 4)).astype(onp.float32)
+    # normalize to valid corner boxes
+    a = onp.concatenate([onp.minimum(a[:, :2], a[:, 2:]),
+                         onp.maximum(a[:, :2], a[:, 2:]) + 0.05], 1)
+    b = onp.concatenate([onp.minimum(b[:, :2], b[:, 2:]),
+                         onp.maximum(b[:, :2], b[:, 2:]) + 0.05], 1)
+    got = npx.box_iou(np.array(a), np.array(b)).asnumpy()
+    want = onp.array([[_iou_ref(x, y) for y in b] for x in a])
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_box_iou_center_format():
+    a = onp.array([[0.5, 0.5, 1.0, 1.0]], dtype=onp.float32)  # center
+    b = onp.array([[0.0, 0.0, 1.0, 1.0]], dtype=onp.float32)  # corner == same
+    got = npx.box_iou(np.array(a), np.array(a), format="center").asnumpy()
+    assert_almost_equal(got, onp.ones((1, 1)), rtol=1e-6)
+    got2 = npx.box_iou(np.array(b), np.array(b), format="corner").asnumpy()
+    assert_almost_equal(got2, onp.ones((1, 1)), rtol=1e-6)
+
+
+def test_box_nms_basic():
+    # rows: [class_id, score, x1, y1, x2, y2]
+    data = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.0, 0.0, 0.9, 0.9],   # overlaps row0 → suppressed
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0],   # far away → kept
+        [1, 0.6, 0.05, 0.05, 1.0, 1.0],  # other class → kept w/o force
+        [0, 0.01, 0.0, 0.0, 1.0, 1.0],  # below valid_thresh
+    ], dtype=onp.float32)
+    out = npx.box_nms(np.array(data), overlap_thresh=0.5, valid_thresh=0.05,
+                      id_index=0).asnumpy()
+    kept = out[out[:, 0] >= 0]
+    assert kept.shape[0] == 3
+    assert_almost_equal(onp.sort(kept[:, 1])[::-1],
+                        onp.array([0.9, 0.7, 0.6], onp.float32), rtol=1e-6)
+    # suppressed rows are -1 (reference contract), shape preserved
+    assert out.shape == data.shape
+    assert (out[3:] == -1).all()
+
+    out_f = npx.box_nms(np.array(data), overlap_thresh=0.5, valid_thresh=0.05,
+                        id_index=0, force_suppress=True).asnumpy()
+    kept_f = out_f[out_f[:, 0] >= 0]
+    assert kept_f.shape[0] == 2  # class-1 box now suppressed by row0
+
+
+def test_box_nms_batch_and_topk():
+    data = onp.random.uniform(0, 1, (2, 8, 6)).astype(onp.float32)
+    data[..., 2:4] = onp.minimum(data[..., 2:4], 0.4)
+    data[..., 4:6] = data[..., 2:4] + 0.3
+    out = npx.box_nms(np.array(data), topk=2, id_index=0).asnumpy()
+    assert out.shape == data.shape
+    for b in range(2):
+        assert (out[b, :, 0] >= 0).sum() <= 2
+
+
+def test_box_encode_decode_roundtrip():
+    B, N = 2, 16
+    anchors = onp.random.uniform(0.1, 0.4, (B, N, 4)).astype(onp.float32)
+    anchors[..., 2:] = anchors[..., :2] + 0.3
+    refs = anchors + 0.02  # ground truth near anchors
+    samples = onp.ones((B, N), onp.float32)
+    matches = onp.stack([onp.arange(N) % N] * B).astype(onp.float32)
+    # encode each anchor against itself-ish gt
+    t, m = npx.box_encode(np.array(samples), np.array(matches),
+                          np.array(anchors), np.array(refs))
+    assert m.asnumpy().min() == 1.0
+    dec = npx.box_decode(t, np.array(anchors), format="corner").asnumpy()
+    assert_almost_equal(dec, refs, rtol=1e-3, atol=1e-4)
+
+
+def test_roi_pooling_simple():
+    data = onp.arange(16, dtype=onp.float32).reshape(1, 1, 4, 4)
+    rois = onp.array([[0, 0, 0, 3, 3]], dtype=onp.float32)
+    out = npx.roi_pooling(np.array(data), np.array(rois),
+                          pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    want = onp.array([[[[5.0, 7.0], [13.0, 15.0]]]])
+    assert_almost_equal(out, want, rtol=1e-6)
+
+
+def test_roi_align_constant_field():
+    # constant feature map → every aligned sample returns the constant
+    data = onp.full((1, 3, 8, 8), 2.5, onp.float32)
+    rois = onp.array([[0, 1.0, 1.0, 6.0, 6.0]], onp.float32)
+    out = npx.roi_align(np.array(data), np.array(rois),
+                        pooled_size=(3, 3)).asnumpy()
+    assert out.shape == (1, 3, 3, 3)
+    assert_almost_equal(out, onp.full_like(out, 2.5), rtol=1e-6)
+
+
+def test_roi_align_gradient_flows():
+    data = np.array(onp.random.randn(1, 2, 6, 6).astype(onp.float32))
+    rois = np.array(onp.array([[0, 0.5, 0.5, 4.5, 4.5]], onp.float32))
+    data.attach_grad()
+    with mx.autograd.record():
+        y = npx.roi_align(data, rois, pooled_size=(2, 2))
+        loss = y.sum()
+    loss.backward()
+    g = data.grad.asnumpy()
+    assert onp.abs(g).sum() > 0
+
+
+def test_upsampling_nearest_and_bilinear():
+    x = onp.arange(8, dtype=onp.float32).reshape(1, 2, 2, 2)
+    up = npx.upsampling(np.array(x), scale=2).asnumpy()
+    assert up.shape == (1, 2, 4, 4)
+    assert (up[0, 0, :2, :2] == x[0, 0, 0, 0]).all()
+    upb = npx.upsampling(np.array(x), scale=2,
+                         sample_type="bilinear").asnumpy()
+    assert upb.shape == (1, 2, 4, 4)
+    # corners preserved under align_corners bilinear
+    assert_almost_equal(upb[..., 0, 0], x[..., 0, 0], rtol=1e-6)
+    assert_almost_equal(upb[..., -1, -1], x[..., -1, -1], rtol=1e-6)
+
+
+def test_bilinear_resize_matches_scipy_style():
+    x = onp.random.randn(2, 3, 5, 7).astype(onp.float32)
+    out = npx.bilinear_resize_2d(np.array(x), height=10, width=14).asnumpy()
+    assert out.shape == (2, 3, 10, 14)
+    # align_corners: endpoints exact
+    assert_almost_equal(out[..., 0, 0], x[..., 0, 0], rtol=1e-5)
+    assert_almost_equal(out[..., -1, -1], x[..., -1, -1], rtol=1e-5)
+    # identity when size unchanged
+    same = npx.bilinear_resize_2d(np.array(x), height=5, width=7).asnumpy()
+    assert_almost_equal(same, x, rtol=1e-5)
+
+
+def test_moments():
+    x = onp.random.randn(3, 4, 5).astype(onp.float32)
+    mean, var = npx.moments(np.array(x), axes=(0, 2))
+    assert_almost_equal(mean.asnumpy(), x.mean(axis=(0, 2)), rtol=1e-5)
+    assert_almost_equal(var.asnumpy(), x.var(axis=(0, 2)), rtol=1e-4,
+                        atol=1e-5)
+    m2, v2 = npx.moments(np.array(x), axes=(1,), keepdims=True)
+    assert m2.shape == (3, 1, 5)
+
+
+def test_hard_sigmoid_activation():
+    x = np.array(onp.linspace(-5, 5, 11).astype(onp.float32))
+    y = npx.activation(x, act_type="hard_sigmoid").asnumpy()
+    assert y.min() == 0.0 and y.max() == 1.0
+
+
+class _SSDHead(mx.gluon.HybridBlock):
+    """Minimal SSD-style head: backbone conv → class + box predictors."""
+
+    def __init__(self, num_classes=3, num_anchors=4):
+        super().__init__()
+        self.backbone = mx.gluon.nn.Conv2D(8, 3, padding=1, activation="relu")
+        self.cls = mx.gluon.nn.Conv2D(num_anchors * (num_classes + 1), 3,
+                                      padding=1)
+        self.box = mx.gluon.nn.Conv2D(num_anchors * 4, 3, padding=1)
+
+    def forward(self, x):
+        f = self.backbone(x)
+        return self.cls(f), self.box(f)
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_ssd_style_head_trains(hybridize):
+    """VERDICT #4 done-criterion: a detection head builds and trains both
+    eagerly and hybridized."""
+    net = _SSDHead()
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    trainer = mx.gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+    x = np.array(onp.random.randn(2, 3, 16, 16).astype(onp.float32))
+    cls_t = np.array(onp.random.randn(2, 16, 16, 16).astype(onp.float32))
+    box_t = np.array(onp.random.randn(2, 16, 16, 16).astype(onp.float32))
+    losses = []
+    for _ in range(3):
+        with mx.autograd.record():
+            cls_p, box_p = net(x)
+            loss = ((cls_p - cls_t) ** 2).mean() + \
+                npx.smooth_l1(box_p - box_t, scalar=1.0).mean()
+        loss.backward()
+        trainer.step(2)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
