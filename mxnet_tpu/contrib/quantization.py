@@ -1,12 +1,18 @@
 """Int8 quantization (reference: python/mxnet/contrib/quantization.py over
-src/operator/quantization/ — quantize/dequantize/requantize ops, calibration,
-quantize_graph_pass).
+src/operator/quantization/ — quantize/dequantize/requantize ops, calibration
+calibrate.cc, quantize_graph_pass.cc).
 
-TPU-native scope: symmetric int8 quantize/dequantize ops (XLA int8 matmul is
-MXU-native), minmax + entropy-free calibration over a data iterator, and
-``quantize_net`` converting Dense layers to int8 weight storage with
-dequantize-on-use — the weight-compression deployment path. Full int8
-activation flows are a later milestone.
+TPU-native scope:
+- symmetric int8 quantize/dequantize ops (XLA int8 matmul is MXU-native);
+- **activation calibration** over a data iterator: per-layer input ranges
+  collected by instrumented forwards, reduced either by absmax
+  (``calib_mode='naive'``) or by KL-divergence threshold search
+  (``calib_mode='entropy'`` — the reference's
+  src/operator/quantization/calibrate.cc algorithm);
+- a static int8 inference path: activations quantized with the CALIBRATED
+  scale, int8×int8 matmul accumulated in int32, rescaled by s_x·s_w —
+  Dense runs genuinely integer GEMMs; conv uses exact integer arithmetic
+  carried in float (small-K accumulations are exact below 2^24).
 """
 from __future__ import annotations
 
@@ -16,23 +22,25 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..ops.registry import register, apply_op
 
-__all__ = ["quantize", "dequantize", "calib_minmax", "quantize_net",
-           "QuantizedDense"]
+__all__ = ["quantize", "dequantize", "calib_minmax", "calibrate_net",
+           "quantize_net", "QuantizedDense"]
 
 
 @register("contrib_quantize")
-def _quantize(scale=None):
+def _quantize(scale=None, channel_axis=None):
     import jax.numpy as jnp
 
     def f(x):
-        s = scale if scale is not None else None
-        if s is None:
-            smax = jnp.max(jnp.abs(x))
-            s_ = smax / 127.0
+        if scale is not None:
+            s_ = jnp.float32(scale)
+        elif channel_axis is not None:
+            axes = tuple(a for a in range(x.ndim) if a != channel_axis)
+            smax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+            s_ = jnp.maximum(smax, 1e-12) / 127.0
         else:
-            s_ = jnp.float32(s)
+            s_ = jnp.maximum(jnp.max(jnp.abs(x)), 1e-12) / 127.0
         q = jnp.clip(jnp.round(x / s_), -127, 127).astype(jnp.int8)
-        return q, jnp.asarray(s_, jnp.float32).reshape(())
+        return q, jnp.asarray(s_, jnp.float32)
 
     return f
 
@@ -47,9 +55,15 @@ def _dequantize():
     return f
 
 
-def quantize(data, scale=None):
-    """Symmetric int8 quantization; returns (q_int8, scale)."""
-    return apply_op("contrib_quantize", data, scale=scale)
+def quantize(data, scale=None, channel_axis=None):
+    """Symmetric int8 quantization; returns (q_int8, scale).
+
+    ``channel_axis`` keeps an independent scale per slice of that axis
+    (per-output-channel weight quantization — the standard accuracy
+    recovery for int8 inference).
+    """
+    return apply_op("contrib_quantize", data, scale=scale,
+                    channel_axis=channel_axis)
 
 
 def dequantize(qdata, scale):
@@ -69,10 +83,164 @@ def calib_minmax(net, data_iter, num_batches=10):
     return max(ranges) if ranges else 1.0
 
 
-class QuantizedDense:
-    """Dense with int8-stored weights, dequantized on use."""
+# ---------------------------------------------------------------------------
+# calibration (reference: calibrate.cc — naive minmax + entropy/KL modes)
+# ---------------------------------------------------------------------------
+_NUM_BINS = 2048
+_NUM_QUANT = 128  # int8 positive levels
 
-    def __init__(self, dense):
+
+def _kl_threshold(hist, hist_max):
+    """KL-divergence-optimal |x| clipping threshold for int8.
+
+    The reference algorithm (calibrate.cc LayerHistogramCollector →
+    GetOptimalThreshold): for each candidate threshold, compare the clipped
+    reference distribution P against its 128-level quantization Q and pick
+    the threshold minimizing KL(P||Q).
+    """
+    hist = hist.astype(onp.float64)
+    if hist.sum() == 0 or hist_max == 0:
+        return 1.0
+    best_kl, best_t = onp.inf, hist_max
+    for i in range(_NUM_QUANT, _NUM_BINS + 1, 16):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into the last bin
+        if p.sum() == 0:
+            continue
+        # quantize the i bins down to _NUM_QUANT levels
+        factor = i / _NUM_QUANT
+        q = onp.zeros(i)
+        for j in range(_NUM_QUANT):
+            lo, hi = int(round(j * factor)), int(round((j + 1) * factor))
+            hi = max(hi, lo + 1)
+            chunk = hist[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = onp.where(chunk > 0, chunk.sum() / nz, 0)
+        pn = p / p.sum()
+        qs = q.sum()
+        if qs == 0:
+            continue
+        qn = q / qs
+        mask = pn > 0
+        kl = float(onp.sum(onp.where(
+            mask, pn * onp.log(onp.maximum(pn, 1e-12) /
+                               onp.maximum(qn, 1e-12)), 0.0)))
+        if kl < best_kl:
+            best_kl, best_t = kl, (i / _NUM_BINS) * hist_max
+    return best_t
+
+
+class _LayerStats:
+    __slots__ = ("absmax", "hist", "samples")
+
+    def __init__(self):
+        self.absmax = 0.0
+        self.hist = onp.zeros(_NUM_BINS, onp.int64)
+        self.samples = 0
+
+    def update(self, arr):
+        self.samples += 1
+        a = onp.abs(onp.asarray(arr, dtype=onp.float32)).ravel()
+        m = float(a.max()) if a.size else 0.0
+        if m > self.absmax:
+            # rescale the existing histogram onto the new range (reference
+            # keeps a fixed range per layer; rebinning avoids a second pass)
+            if self.hist.sum() and self.absmax > 0:
+                idx = (onp.arange(_NUM_BINS) *
+                       (self.absmax / m)).astype(onp.int64)
+                newh = onp.zeros_like(self.hist)
+                onp.add.at(newh, onp.clip(idx, 0, _NUM_BINS - 1), self.hist)
+                self.hist = newh
+            self.absmax = m
+        if self.absmax > 0:
+            idx = onp.clip((a / self.absmax * (_NUM_BINS - 1)).astype(
+                onp.int64), 0, _NUM_BINS - 1)
+            onp.add.at(self.hist, idx, onp.ones_like(idx, onp.int64))
+
+    def scale(self, mode):
+        if mode == "entropy":
+            return _kl_threshold(self.hist, self.absmax) / 127.0
+        return (self.absmax or 1.0) / 127.0
+
+
+def calibrate_net(net, data_iter, num_batches=10, calib_mode="naive"):
+    """Run calibration batches through ``net`` recording per-layer INPUT
+    statistics for every Dense/Conv layer. Returns {layer_path: act_scale}.
+
+    calib_mode 'naive' = absmax/127; 'entropy' = KL-optimal threshold
+    (reference: quantize_net calib_mode, calibrate.cc).
+    """
+    from ..gluon.nn.basic_layers import Dense
+    from ..gluon.nn.conv_layers import _Conv
+
+    if calib_mode not in ("naive", "minmax", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
+    targets = {}
+
+    def _find(block, prefix=""):
+        for name, child in block._children.items():
+            path = prefix + name
+            if isinstance(child, (Dense, _Conv)):
+                targets[path] = child
+            else:
+                _find(child, path + ".")
+
+    _find(net)
+    stats = {p: _LayerStats() for p in targets}
+    originals = {}
+    # hybridized nets serve cached compiled graphs and never reach
+    # child.forward — force eager execution for the calibration passes
+    hybrid_state = []
+
+    def _deactivate(block):
+        if getattr(block, "_active", False):
+            hybrid_state.append((block, dict(block._cached)))
+            block._active = False
+            block._cached = {}
+        for child in getattr(block, "_children", {}).values():
+            _deactivate(child)
+
+    _deactivate(net)
+    try:
+        for path, layer in targets.items():
+            originals[path] = layer.forward
+
+            def wrapped(x, *a, _orig=originals[path], _st=stats[path],
+                        **kw):
+                _st.update(x.asnumpy())
+                return _orig(x, *a, **kw)
+
+            layer.forward = wrapped
+        n = 0
+        for batch in data_iter:
+            if n >= num_batches:
+                break
+            data = batch.data[0] if hasattr(batch, "data") else (
+                batch[0] if isinstance(batch, (tuple, list)) else batch)
+            net(data)
+            n += 1
+    finally:
+        for path, layer in targets.items():
+            layer.forward = originals[path]
+        for block, cached in hybrid_state:
+            block._active = True
+            block._cached = cached
+    dead = [p for p, s in stats.items() if s.samples == 0]
+    if dead:
+        raise MXNetError(
+            f"calibration saw no data for layers {dead} — the calibration "
+            "batches never exercised them; widen the calibration set or "
+            "exclude those layers")
+    mode = "entropy" if calib_mode == "entropy" else "naive"
+    return {p: s.scale(mode) for p, s in stats.items()}
+
+
+class QuantizedDense:
+    """Dense with int8 weights; with a calibrated activation scale the
+    forward is a true int8×int8→int32 GEMM (MXU-native on TPU)."""
+
+    def __init__(self, dense, act_scale=None):
         from ..gluon.nn.basic_layers import Dense
 
         if not isinstance(dense, Dense):
@@ -81,32 +249,112 @@ class QuantizedDense:
         self._flatten = dense._flatten
         self._activation = dense._activation
         w = dense.weight.data()
-        self.qweight, self.wscale = quantize(w)
+        # per-output-channel weight scales (axis 0 of (units, in))
+        self.qweight, self.wscale = quantize(w, channel_axis=0)
+        self.act_scale = act_scale
         self.bias = dense.bias.data() if dense.bias is not None else None
 
     def __call__(self, x):
         from .. import numpy_extension as npx
 
-        w = dequantize(self.qweight, self.wscale)
-        out = npx.fully_connected(x, w, self.bias,
-                                  num_hidden=self._units,
-                                  no_bias=self.bias is None,
-                                  flatten=self._flatten)
+        if self.act_scale is not None:
+            args = [x, self.qweight, self.wscale]
+            if self.bias is not None:
+                args.append(self.bias)
+            out = apply_op("quantized_fully_connected", *args,
+                           act_scale=float(self.act_scale),
+                           no_bias=self.bias is None,
+                           flatten=self._flatten)
+        else:
+            w = dequantize(self.qweight, self.wscale)
+            out = npx.fully_connected(x, w, self.bias,
+                                      num_hidden=self._units,
+                                      no_bias=self.bias is None,
+                                      flatten=self._flatten)
         if self._activation:
             out = npx.activation(out, act_type=self._activation)
         return out
 
 
-def quantize_net(net, quantized_dtype="int8", exclude_layers=None):
-    """Replace Dense children with int8-weight versions (in place).
+@register("quantized_fully_connected")
+def _quantized_fc(act_scale=1.0, no_bias=False, flatten=True):
+    """int8 activation × int8 weight → int32 accumulation → fp32 rescale
+    (reference: quantized_fully_connected.cc)."""
+    import jax.numpy as jnp
 
-    Reference: quantize_net / quantize_graph_pass for the weight path.
+    def f(x, qw, wscale, *bias):
+        if flatten and x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        qx = jnp.clip(jnp.round(x / act_scale), -127, 127).astype(jnp.int8)
+        acc = jnp.matmul(qx.astype(jnp.int32),
+                         qw.astype(jnp.int32).T)          # exact int32
+        out = acc.astype(jnp.float32) * (act_scale *
+                                         wscale.reshape(1, -1))
+        if bias:
+            out = out + bias[0]
+        return out
+
+    return f
+
+
+class _QuantizedConvCore:
+    """Conv with int8 weights + calibrated activation scale. Integer values
+    are carried in fp32 through XLA's conv (exact for |acc| < 2^24) — the
+    MXU consumes them natively; a dedicated int8 conv kernel is a later
+    optimization."""
+
+    def __init__(self, conv, act_scale=None):
+        self._conv_attrs = dict(kernel=conv._kernel, stride=conv._stride,
+                                dilate=conv._dilate, pad=conv._pad,
+                                num_filter=conv._channels,
+                                num_group=conv._groups,
+                                layout=conv._layout)
+        self._activation = conv._activation
+        self.qweight, self.wscale = quantize(conv.weight.data(),
+                                             channel_axis=0)
+        self.act_scale = act_scale
+        self.bias = conv.bias.data() if conv.bias is not None else None
+
+    def __call__(self, x):
+        from .. import numpy_extension as npx
+        from .. import np as mnp
+
+        if self.act_scale is not None:
+            s = float(self.act_scale)
+            qx = mnp.clip(mnp.round_(x / s), -127, 127)
+            w = self.qweight.astype("float32")
+            out = npx.convolution(qx, w, None, **self._conv_attrs)
+            out = out * (s * self.wscale.reshape(1, -1, 1, 1))
+            if self.bias is not None:
+                out = out + self.bias.reshape(1, -1, 1, 1)
+        else:
+            w = dequantize(self.qweight, self.wscale)
+            out = npx.convolution(x, w, self.bias, **self._conv_attrs)
+        if self._activation is not None:
+            out = npx.activation(out, act_type=self._activation)
+        return out
+
+
+def quantize_net(net, quantized_dtype="int8", exclude_layers=None,
+                 calib_data=None, calib_mode="naive", num_calib_batches=10):
+    """Replace Dense/Conv children with int8 versions (in place).
+
+    With ``calib_data`` the activation scales are calibrated first
+    (``calib_mode``: 'naive' absmax or 'entropy' KL) and the quantized
+    layers run the static int8 path; without it, weights-only quantization
+    with dequantize-on-use. Reference: quantize_net → quantize_graph_pass
+    + calibrate.cc.
     """
     if quantized_dtype != "int8":
         raise MXNetError("only int8 weight quantization is supported")
     from ..gluon.nn.basic_layers import Dense
+    from ..gluon.nn.conv_layers import _Conv
 
     exclude = set(exclude_layers or [])
+    scales = {}
+    if calib_data is not None:
+        scales = calibrate_net(net, calib_data, num_calib_batches,
+                               calib_mode)
 
     def _convert(block, prefix=""):
         # any rewired block's compiled graphs are stale — drop them so the
@@ -115,9 +363,20 @@ def quantize_net(net, quantized_dtype="int8", exclude_layers=None):
             block._cached = {}
         for name, child in list(block._children.items()):
             path = prefix + name
-            if isinstance(child, Dense) and path not in exclude and \
+            if path in exclude:
+                continue
+            if isinstance(child, Dense) and child.weight._data is not None:
+                block._children[name] = _QuantizedDenseBlock(
+                    child, scales.get(path))
+                setattr(block, name, block._children[name])
+            elif isinstance(child, _Conv) and not child._transpose and \
+                    child._layout == "NCHW" and len(child._kernel) == 2 and \
                     child.weight._data is not None:
-                block._children[name] = _QuantizedDenseBlock(child)
+                # the int8 conv core scales along axis 1 of a 4-D NCHW
+                # output; other ranks/layouts stay fp32 rather than
+                # mis-scale (Conv1D/3D int8 is a later tier)
+                block._children[name] = _QuantizedDenseBlock(
+                    child, scales.get(path))
                 setattr(block, name, block._children[name])
             else:
                 _convert(child, path + ".")
@@ -129,8 +388,13 @@ def quantize_net(net, quantized_dtype="int8", exclude_layers=None):
 class _QuantizedDenseBlock:
     """Block-shaped wrapper so quantized layers slot into Sequentials."""
 
-    def __init__(self, dense):
-        self._q = QuantizedDense(dense)
+    def __init__(self, layer, act_scale=None):
+        from ..gluon.nn.basic_layers import Dense
+
+        if isinstance(layer, Dense):
+            self._q = QuantizedDense(layer, act_scale)
+        else:
+            self._q = _QuantizedConvCore(layer, act_scale)
         self._children = {}
         self._reg_params = {}
 

@@ -51,6 +51,7 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._kv_initialized = False
         self._kvstore_spec = kvstore
+        self._compression_params = compression_params
         self._scale = self._optimizer.rescale_grad
         self._fused_fn = None  # {active-param tuple: jitted multi-step}
 
@@ -77,6 +78,9 @@ class Trainer:
             return
         self._kvstore = spec if isinstance(spec, kvs_mod.KVStoreBase) \
             else kvs_mod.create(spec)
+        if self._compression_params and \
+                hasattr(self._kvstore, "set_gradient_compression"):
+            self._kvstore.set_gradient_compression(self._compression_params)
         if self._update_on_kvstore:
             self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = True

@@ -48,6 +48,8 @@ class KVStore(KVStoreBase):
         self._store: dict = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None   # (type, params)
+        self._residuals: dict = {}  # (key, slot) -> error-feedback residual
 
     @property
     def type(self):
@@ -63,15 +65,58 @@ class KVStore(KVStoreBase):
         for k, v in zip(keys, vals):
             self._store[k] = NDArray(_as_list(v)[0]._data)
 
-    def _reduce(self, vlist):
+    # -- gradient compression (reference: src/kvstore/gradient_compression.h
+    # :38-52 — 1/2-bit stochastic quantization with error feedback;
+    # kvstore.h:86 SetGradientCompression). TPU analog: compress each
+    # contribution before it enters the (cross-host) reduction; the residual
+    # re-enters the next round so the compressed stream is unbiased. -------
+    def set_gradient_compression(self, compression_params):
+        ctype = (compression_params or {}).get("type")
+        if ctype is None:
+            self._compression = None
+            return
+        if ctype not in ("bf16", "int8", "2bit"):
+            raise MXNetError(
+                f"unsupported gradient compression type {ctype!r}; "
+                "supported: bf16, int8, 2bit")
+        self._compression = (ctype, dict(compression_params))
+        self._residuals.clear()
+
+    def _compress(self, g, slot_key):
+        """Quantize one gradient contribution with error feedback. Returns
+        the decompressed-representable value (what the wire carries)."""
+        import jax.numpy as jnp
+
+        ctype, params = self._compression
+        res = self._residuals.get(slot_key)
+        gc = g + res if res is not None else g
+        if ctype == "bf16":
+            sent = gc.astype(jnp.bfloat16).astype(g.dtype)
+        elif ctype == "int8":
+            scale = jnp.maximum(jnp.max(jnp.abs(gc)), 1e-12) / 127.0
+            sent = jnp.round(gc / scale).astype(jnp.int8).astype(
+                g.dtype) * scale
+        else:  # 2bit: ±threshold or 0 (gradient_compression.h 2-bit scheme)
+            t = float(params.get("threshold", 0.5))
+            sent = jnp.where(gc >= t, t, jnp.where(gc <= -t, -t, 0.0)
+                             ).astype(g.dtype)
+        self._residuals[slot_key] = gc - sent
+        return sent
+
+    def _reduce(self, vlist, key=None):
         """Sum values (possibly one per device) into one array.
 
         Reference: CommCPU/CommDevice::Reduce (src/kvstore/comm.h:104).
         """
         vlist = _as_list(vlist)
-        acc = vlist[0]._data
-        for v in vlist[1:]:
-            acc = acc + v._data
+        if self._compression is not None and key is not None:
+            datas = [self._compress(v._data, (key, i))
+                     for i, v in enumerate(vlist)]
+        else:
+            datas = [v._data for v in vlist]
+        acc = datas[0]
+        for d in datas[1:]:
+            acc = acc + d
         return acc
 
     def push(self, key, value, priority=0):
@@ -79,7 +124,7 @@ class KVStore(KVStoreBase):
         for k, v in zip(keys, vals):
             # reduce locally, then across workers (reference: server-side
             # merge of all workers' pushes, kvstore_dist_server.h:346)
-            red = self._global_reduce(self._reduce(v))
+            red = self._global_reduce(self._reduce(v, key=k))
             if self._updater is not None:
                 if k not in self._store:
                     self._store[k] = NDArray(red)
@@ -102,7 +147,7 @@ class KVStore(KVStoreBase):
         keys, vals = _keys_vals(key, value)
         outs = [None] * len(keys) if out is None else _keys_vals(key, out)[1]
         for k, v, o in zip(keys, vals, outs):
-            red = self._reduce(v)
+            red = self._reduce(v, key=k)
             red = self._global_reduce(red)
             if self._updater is not None and o is not None:
                 if k not in self._store:

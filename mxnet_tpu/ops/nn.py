@@ -564,9 +564,27 @@ def _multihead_attention(num_heads=1, dropout=0.0, causal=False, scale=None):
 @register("flash_attention")
 def _flash_attention_op(num_heads=1, causal=False, scale=None):
     def f(q, k, v):
-        # (B, H, T, D) layout
+        # canonical layout (B, H, T, D); rank-2/3 operands (headless
+        # attention, e.g. the optimize_for rewrite of a 3-D matmul chain)
+        # are lifted to 4-D and the output restored — the kernel itself is
+        # rank-4 only
         from .pallas_kernels import flash_attention
 
-        return flash_attention(q, k, v, scale, causal)
+        ndim = q.ndim
+        if ndim == 2:
+            qq, kk, vv = (a[None, None] for a in (q, k, v))
+        elif ndim == 3:
+            qq, kk, vv = (a[:, None] for a in (q, k, v))
+        elif ndim == 4:
+            qq, kk, vv = q, k, v
+        else:
+            raise MXNetError(
+                f"flash_attention expects rank 2-4 operands, got {ndim}")
+        out = flash_attention(qq, kk, vv, scale, causal)
+        if ndim == 2:
+            return out[0, 0]
+        if ndim == 3:
+            return out[:, 0]
+        return out
 
     return f

@@ -40,6 +40,18 @@ def _on_tpu() -> bool:
         return False
 
 
+def _interpret() -> bool:
+    """Run Pallas kernels in interpreter mode (works on the CPU test mesh) —
+    lets the kernel code paths be exercised without TPU hardware."""
+    import os
+
+    return os.environ.get("MXTPU_PALLAS_INTERPRET", "") == "1"
+
+
+def _use_pallas() -> bool:
+    return _HAVE_PALLAS and (_on_tpu() or _interpret())
+
+
 # ---------------------------------------------------------------------------
 # reference (XLA) attention — also the vjp recompute path
 # ---------------------------------------------------------------------------
@@ -57,8 +69,8 @@ def _attention_reference(q, k, v, scale, causal):
 # ---------------------------------------------------------------------------
 # flash attention forward kernel
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                      scale, causal, block_q, block_k, seq_k,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                      l_ref, *, scale, causal, block_q, block_k, seq_k,
                       causal_offset=0):
     qb = pl.program_id(1)
     q = q_ref[0]  # (BQ, D) — stays in input dtype so the MXU runs bf16
@@ -96,6 +108,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     jax.lax.fori_loop(0, num_kb, body, 0)
     o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+    # log-sum-exp per query row: saved for the backward kernels, which
+    # reconstruct p = exp(s - lse) without a second online-softmax pass
+    lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
 
 
 try:  # pallas imports are deferred-safe: CPU-only installs still work
@@ -107,7 +122,8 @@ except Exception:  # noqa: BLE001
     _HAVE_PALLAS = False
 
 
-def _flash_attention_tpu(q, k, v, scale, causal, block_q, block_k):
+def _flash_attention_tpu(q, k, v, scale, causal, block_q, block_k,
+                         return_lse=False):
     """q,k,v: (B, H, T, D) with T % block == 0, D % 128 == 0 (pre-padded)."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
@@ -118,7 +134,7 @@ def _flash_attention_tpu(q, k, v, scale, causal, block_q, block_k):
         _flash_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_k=tk,
         causal_offset=tk - tq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, tq // block_q),
         in_specs=[
@@ -129,9 +145,16 @@ def _flash_attention_tpu(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, tk, d), lambda bh, qb: (bh, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -142,8 +165,12 @@ def _flash_attention_tpu(q, k, v, scale, causal, block_q, block_k):
             bytes_accessed=(qr.size + kr.size + vr.size) * qr.dtype.itemsize,
             transcendentals=b * h * tq * tk,
         ),
+        interpret=_interpret(),
     )(qr, kr, vr)
-    return out.reshape(b, h, tq, d)
+    out = out.reshape(b, h, tq, d)
+    if return_lse:
+        return out, lse.reshape(b, h, tq, 1)
+    return out
 
 
 def _pad_to(x, axis, multiple):
@@ -168,7 +195,7 @@ def flash_attention(q, k, v, scale=None, causal=False):
 def _flash_attention_impl(q, k, v, scale, causal):
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
-    if not (_HAVE_PALLAS and _on_tpu()):
+    if not _use_pallas():
         return _attention_reference(q, k, v, s, causal)
     # head_dim needs no padding (Mosaic handles sub-lane widths); the seq
     # axes must tile evenly by the block sizes
@@ -182,7 +209,190 @@ def _flash_attention_impl(q, k, v, scale, causal):
 
 
 def _flash_fwd(q, k, v, scale, causal):
-    return _flash_attention_impl(q, k, v, scale, causal), (q, k, v)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    bq = min(DEFAULT_BLOCK_Q, q.shape[2])
+    bk = min(DEFAULT_BLOCK_K, k.shape[2])
+    if _use_pallas() and q.shape[2] % bq == 0 and k.shape[2] % bk == 0:
+        out, lse = _flash_attention_tpu(q, k, v, s, causal, bq, bk,
+                                        return_lse=True)
+        return out, (q, k, v, out, lse)
+    return _attention_reference(q, k, v, s, causal), (q, k, v, None, None)
+
+
+# ---------------------------------------------------------------------------
+# flash attention backward kernels
+#
+# Standard flash-bwd identities with the forward's saved LSE:
+#   p_ij  = exp(s_ij - lse_i)
+#   dv_j  = Σ_i p_ij g_i
+#   dp_ij = g_i · v_j
+#   ds_ij = p_ij (dp_ij - Δ_i) * scale,   Δ_i = Σ_d g_id o_id
+#   dq_i  = Σ_j ds_ij k_j ;  dk_j = Σ_i ds_ij q_i
+# Two kernels: one gridded over KV blocks (dk, dv), one over Q blocks (dq).
+# No O(T²) materialization; accumulation in fp32 VMEM scratch.
+# ---------------------------------------------------------------------------
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                          block_q, block_k, seq_q, causal_offset):
+    kb = pl.program_id(1)
+    k = k_ref[0]  # (BK, D)
+    v = v_ref[0]
+    num_qb = seq_q // block_q
+
+    dk_acc[:] = jnp.zeros_like(dk_acc)
+    dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def body(qb, _):
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        g = g_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q), :]   # (BQ, 1)
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (BQ, BK)
+        if causal:
+            qi = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            ki = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi + causal_offset >= ki, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                  # normalized
+        gf = g.astype(jnp.float32)
+        dv_acc[:] += jax.lax.dot_general(
+            p, gf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (BK, D)
+        dp = jax.lax.dot_general(
+            gf, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (BQ, BK)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (BK, D)
+        return 0
+
+    jax.lax.fori_loop(0, num_qb, body, 0)
+    dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, scale, causal, block_q,
+                         block_k, seq_k, causal_offset):
+    qb = pl.program_id(1)
+    q = q_ref[0]   # (BQ, D)
+    g = g_ref[0]
+    lse = lse_ref[0]    # (BQ, 1)
+    delta = delta_ref[0]
+    num_kb = seq_k // block_k
+
+    dq_acc[:] = jnp.zeros_like(dq_acc)
+    gf = g.astype(jnp.float32)
+
+    def body(kb, _):
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qi = qb * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            ki = kb * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qi + causal_offset >= ki, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            gf, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return 0
+
+    jax.lax.fori_loop(0, num_kb, body, 0)
+    dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_tpu(q, k, v, out, lse, g, scale, causal, block_q, block_k):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    qr = q.reshape(b * h, tq, d)
+    kr = k.reshape(b * h, tk, d)
+    vr = v.reshape(b * h, tk, d)
+    gr = g.reshape(b * h, tq, d)
+    lser = lse.reshape(b * h, tq, 1)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True).reshape(b * h, tq, 1)
+    off = tk - tq
+
+    full_q = pl.BlockSpec((1, tq, d), lambda bh, blk: (bh, 0, 0),
+                          memory_space=pltpu.VMEM)
+    full_k = pl.BlockSpec((1, tk, d), lambda bh, blk: (bh, 0, 0),
+                          memory_space=pltpu.VMEM)
+    full_stat = pl.BlockSpec((1, tq, 1), lambda bh, blk: (bh, 0, 0),
+                             memory_space=pltpu.VMEM)
+    kv_blk = pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0),
+                          memory_space=pltpu.VMEM)
+    dkv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=tq,
+                          causal_offset=off),
+        grid=(b * h, tk // block_k),
+        in_specs=[full_q, kv_blk, kv_blk, full_q, full_stat, full_stat],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=5 * b * h * tq * tk * d,
+            bytes_accessed=(qr.size * 2 + kr.size * 3) * qr.dtype.itemsize,
+            transcendentals=b * h * tq * tk,
+        ),
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lser, delta)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_k=tk,
+                          causal_offset=off),
+        grid=(b * h, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
+                         memory_space=pltpu.VMEM),
+            full_k, full_k,
+            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=3 * b * h * tq * tk * d,
+            bytes_accessed=(qr.size * 2 + kr.size * 2) * qr.dtype.itemsize,
+            transcendentals=b * h * tq * tk,
+        ),
+        interpret=_interpret(),
+    )(qr, kr, vr, gr, lser, delta)
+    return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
+            dv.reshape(b, h, tk, d))
 
 
 _BWD_BLOCK = 512
@@ -276,9 +486,14 @@ def _attention_bwd_blockwise(q, k, v, g, scale, causal):
 
 
 def _flash_bwd(scale, causal, res, g):
-    q, k, v = res
+    q, k, v, out, lse = res
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
+    if lse is not None and _use_pallas():
+        bq = min(DEFAULT_BLOCK_Q, q.shape[2])
+        bk = min(DEFAULT_BLOCK_K, k.shape[2])
+        if q.shape[2] % bq == 0 and k.shape[2] % bk == 0:
+            return _flash_bwd_tpu(q, k, v, out, lse, g, s, causal, bq, bk)
     return _attention_bwd_blockwise(q, k, v, g, s, causal)
 
 
@@ -318,7 +533,7 @@ def _ln_reference(x, gamma, beta, eps):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _fused_ln(x, gamma, beta, eps):
-    if not (_HAVE_PALLAS and _on_tpu()):
+    if not _use_pallas():
         return _ln_reference(x, gamma, beta, eps)
     d = x.shape[-1]
     if d % 128 != 0:
@@ -343,6 +558,7 @@ def _fused_ln(x, gamma, beta, eps):
         out_specs=pl.BlockSpec((br, d), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=_interpret(),
     )(xr, gamma, beta)
     return out.reshape(orig_shape)
 
@@ -378,7 +594,7 @@ def fused_softmax(x):
 
 def _fused_softmax_impl(x):
     d = x.shape[-1]
-    if not (_HAVE_PALLAS and _on_tpu()) or d % 128 != 0:
+    if not _use_pallas() or d % 128 != 0:
         return jax.nn.softmax(x, axis=-1)
     rows = 1
     for sdim in x.shape[:-1]:
@@ -395,6 +611,7 @@ def _fused_softmax_impl(x):
         out_specs=pl.BlockSpec((br, d), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        interpret=_interpret(),
     )(xr)
     return out.reshape(x.shape)
 

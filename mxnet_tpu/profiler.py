@@ -63,12 +63,92 @@ def dump(finished=True, profile_process="worker"):
         stop()
 
 
+# -- xplane → per-op aggregate stats (reference: aggregate_stats.cc) --------
+_INFRA_PREFIXES = ("ThreadpoolListener", "ThunkExecutor", "TaskDispatcher",
+                   "end:", "$", "Memcpy", "Stream #", "InfeedDequeue")
+
+
+def _is_op_event(name: str) -> bool:
+    if not name or name.startswith(_INFRA_PREFIXES):
+        return False
+    return "::" not in name
+
+
+def get_device_op_stats(trace_dir=None):
+    """Parse the captured xplane trace into {op_name: (calls, total_ns)}.
+
+    Device planes (TPU) and XLA-client lines (CPU) both carry one event per
+    executed XLA op; infrastructure events are filtered out. This is the
+    data source for the reference's per-op aggregate table
+    (src/profiler/aggregate_stats.cc) rebuilt over the XLA profiler.
+    """
+    import glob
+
+    tdir = trace_dir or _trace_dir
+    if tdir is None:
+        return {}
+    files = sorted(glob.glob(os.path.join(tdir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not files:
+        return {}
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        return {}
+    stats: dict[str, list] = {}
+    pd = ProfileData.from_file(files[-1])
+    for plane in pd.planes:
+        device = "device:" in plane.name.lower() or "tpu" in plane.name.lower()
+        for line in plane.lines:
+            # CPU runs surface XLA ops on the PjRt client lines; TPU runs
+            # on the device plane's op lines
+            client = line.name.startswith("tf_XLA") or \
+                "XLA Ops" in line.name or "XLA Modules" in line.name
+            if not (device or client):
+                continue
+            for ev in line.events:
+                if not _is_op_event(ev.name):
+                    continue
+                s = stats.setdefault(ev.name, [0, 0.0])
+                s[0] += 1
+                s[1] += ev.duration_ns
+    return {k: (c, ns) for k, (c, ns) in stats.items() if ns > 0}
+
+
+def device_memory_info(device=None):
+    """Per-device PJRT memory stats (reference: storage_profiler.h —
+    peak/current allocated bytes). Returns {} when the backend does not
+    report (CPU)."""
+    import jax
+
+    dev = device or jax.devices()[0]
+    stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+    return dict(stats) if stats else {}
+
+
 def dumps(reset=False, format="table"):
-    """Aggregate stats table (reference: aggregate_stats.cc UX)."""
+    """Aggregate stats table (reference: aggregate_stats.cc UX): host
+    ranges, per-op device time from the last captured trace, and peak HBM
+    when the backend reports it."""
     lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
     for name, (total, count) in sorted(_ranges.items()):
         lines.append(f"{name:<40}{count:>8}{total * 1e3:>12.3f}"
                      f"{total * 1e3 / count:>12.3f}")
+    dev = get_device_op_stats()
+    if dev:
+        lines.append("")
+        lines.append(f"{'Device op':<40}{'Calls':>8}{'Total(ms)':>12}"
+                     f"{'Avg(ms)':>12}")
+        for name, (count, ns) in sorted(dev.items(),
+                                        key=lambda kv: -kv[1][1])[:50]:
+            lines.append(f"{name[:40]:<40}{count:>8}{ns / 1e6:>12.3f}"
+                         f"{ns / 1e6 / count:>12.3f}")
+    mem = device_memory_info()
+    if mem.get("peak_bytes_in_use"):
+        lines.append("")
+        lines.append(f"peak_bytes_in_use: {mem['peak_bytes_in_use']:,}")
+        if mem.get("bytes_in_use") is not None:
+            lines.append(f"bytes_in_use:      {mem['bytes_in_use']:,}")
     if reset:
         _ranges.clear()
     return "\n".join(lines)

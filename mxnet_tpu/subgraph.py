@@ -57,3 +57,13 @@ def apply_passes(sym, backend: str):
 
 # built-in default backend: identity (XLA does the real fusion downstream)
 register_backend("default")
+
+
+# the built-in "tpu" backend (flash-attention fusion etc.) registers itself
+# on import; kept in a separate module to avoid a circular import with the
+# Symbol IR
+def _register_builtin_backends():
+    from . import tpu_passes  # noqa: F401
+
+
+_register_builtin_backends()

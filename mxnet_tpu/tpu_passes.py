@@ -1,0 +1,171 @@
+"""Built-in "tpu" subgraph backend: pattern-match-and-replace passes.
+
+TPU-native analog of the reference's subgraph properties
+(src/operator/subgraph/subgraph_property.h:252 SubgraphProperty,
+build_subgraph.cc partitioner; oneDNN's conv+bn+relu fusions are the
+worked example). Here the unit of replacement is a Symbol-IR subgraph and
+the replacement targets are Pallas kernels.
+
+Shipped pass: **attention fusion** — rewrites the hand-written attention
+pattern
+
+    logits = matmul(q, kᵀ)            (or einsum bhqd,bhkd->bhqk)
+    logits = logits * s  |  logits / s  |  matmul(q * s, kᵀ)   [optional]
+    w      = softmax(logits, axis=-1)
+    out    = matmul(w, v)             (or einsum bhqk,bhkd->bhqd)
+
+into one ``flash_attention`` op (Pallas online-softmax kernel, no O(T²)
+HBM materialization). Matched interior nodes must have no other consumers;
+the head node is rewritten in place so downstream references survive.
+"""
+from __future__ import annotations
+
+from .subgraph import register_backend, register_pass
+from .symbol.symbol import Literal, Symbol, topo_sort
+
+register_backend("tpu")
+
+
+def _consumer_counts(nodes, entries):
+    counts: dict[int, int] = {}
+    for n in nodes:
+        for e in n.inputs:
+            if not isinstance(e, Literal):
+                counts[id(e[0])] = counts.get(id(e[0]), 0) + 1
+    for node, _ in entries:
+        counts[id(node)] = counts.get(id(node), 0) + 1
+    return counts
+
+
+def _op_name(node):
+    return node.op.name if node.op is not None else None
+
+
+def _scalar_of(entry):
+    """Literal / 0-d const entry → python float, else None."""
+    if isinstance(entry, Literal):
+        v = entry.value
+        return float(v) if isinstance(v, (int, float)) else None
+    node, _ = entry
+    if node.is_const and getattr(node.value, "ndim", None) == 0:
+        return float(node.value)
+    return None
+
+
+def _is_kt(entry):
+    """Does this entry transpose the last two axes of its input?
+    Returns the un-transposed producer entry, or None."""
+    if isinstance(entry, Literal):
+        return None
+    node, idx = entry
+    name = _op_name(node)
+    if name == "transpose":
+        axes = node.attrs.get("axes")
+        if axes is not None:
+            axes = tuple(axes)
+            n = len(axes)
+            want = tuple(range(n - 2)) + (n - 1, n - 2)
+            if axes == want:
+                return node.inputs[0]
+    elif name == "swapaxes":
+        a1 = node.attrs.get("axis1", 0)
+        a2 = node.attrs.get("axis2", 1)
+        if {a1, a2} in ({-1, -2}, {2, 3}):
+            return node.inputs[0]
+    return None
+
+
+def _match_qk(node):
+    """Match a q·kᵀ logits node → (q_entry, k_entry, scale) or None."""
+    name = _op_name(node)
+    if name == "matmul":
+        q_e, kt_e = node.inputs[0], node.inputs[1]
+        k_e = _is_kt(kt_e)
+        if k_e is None:
+            return None
+        scale = 1.0
+        # scale folded onto q: matmul(multiply(q, s), kT)
+        if not isinstance(q_e, Literal):
+            qn, _ = q_e
+            if _op_name(qn) == "multiply":
+                s = _scalar_of(qn.inputs[1]) or _scalar_of(qn.inputs[0])
+                if s is not None:
+                    other = qn.inputs[0] if _scalar_of(qn.inputs[1]) \
+                        is not None else qn.inputs[1]
+                    return other, k_e, s
+        return q_e, k_e, scale
+    if name == "einsum":
+        sub = node.attrs.get("subscripts", "").replace(" ", "")
+        if sub == "bhqd,bhkd->bhqk":
+            return node.inputs[0], node.inputs[1], 1.0
+    return None
+
+
+def _match_attention(out_node, counts):
+    """Match out_node = matmul(softmax(scale(q·kᵀ)), v). Returns
+    (q_entry, k_entry, v_entry, scale) or None."""
+    name = _op_name(out_node)
+    if name == "matmul":
+        w_e, v_e = out_node.inputs[0], out_node.inputs[1]
+    elif name == "einsum" and out_node.attrs.get(
+            "subscripts", "").replace(" ", "") == "bhqk,bhkd->bhqd":
+        w_e, v_e = out_node.inputs[0], out_node.inputs[1]
+    else:
+        return None
+    if isinstance(w_e, Literal):
+        return None
+    w, _ = w_e
+    if _op_name(w) != "softmax" or counts.get(id(w), 0) != 1:
+        return None
+    if w.attrs.get("axis", -1) not in (-1, 3):
+        return None
+    if w.attrs.get("use_length") or w.attrs.get("temperature") not in (
+            None, 1.0):
+        return None
+    s_e = w.inputs[0]
+    if isinstance(s_e, Literal):
+        return None
+    s_node, _ = s_e
+    scale_mult = 1.0
+    logits = s_node
+    # optional explicit scaling of the logits
+    if _op_name(s_node) in ("multiply", "true_divide") and \
+            counts.get(id(s_node), 0) == 1:
+        sc = _scalar_of(s_node.inputs[1])
+        if sc is None and _op_name(s_node) == "multiply":
+            sc = _scalar_of(s_node.inputs[0])
+            cand = s_node.inputs[1]
+        else:
+            cand = s_node.inputs[0]
+        if sc is not None and not isinstance(cand, Literal):
+            scale_mult = (1.0 / sc if _op_name(s_node) == "true_divide"
+                          else sc)
+            logits = cand[0]
+    if counts.get(id(logits), 0) != 1:
+        return None
+    qk = _match_qk(logits)
+    if qk is None:
+        return None
+    q_e, k_e, q_scale = qk
+    return q_e, k_e, v_e, scale_mult * q_scale
+
+
+@register_pass("tpu")
+def fuse_attention(sym: Symbol) -> Symbol:
+    """Rewrite eligible attention subgraphs onto ``flash_attention``."""
+    from .ops.registry import get_op
+
+    nodes = topo_sort(sym._entries)
+    counts = _consumer_counts(nodes, sym._entries)
+    flash = get_op("flash_attention")
+    for node in nodes:
+        m = _match_attention(node, counts)
+        if m is None:
+            continue
+        q_e, k_e, v_e, scale = m
+        # rewrite the head node in place: downstream (SymNode, idx)
+        # references — including graph outputs — stay valid
+        node.op = flash
+        node.attrs = {"scale": scale, "causal": False}
+        node.inputs = (q_e, k_e, v_e)
+    return sym

@@ -222,3 +222,33 @@ def test_image_iter_over_rec(tmp_path):
     assert batches[0].data[0].shape == (3, 3, 8, 8)
     it.reset()
     assert len(list(it)) == 2
+
+
+def test_profiler_device_op_aggregate_table(tmp_path):
+    """VERDICT #10: per-op device time parsed from the captured xplane
+    trace shows up in mx.profiler.dumps() for a hybridized step."""
+    from mxnet_tpu import profiler
+
+    net = mx.gluon.nn.Dense(64, in_units=64)
+    net.initialize()
+    net.hybridize()
+    x = mx.np.ones((32, 64))
+    net(x)  # compile outside the trace
+    profiler.set_config(trace_dir=str(tmp_path / "xp"))
+    profiler.start()
+    for _ in range(3):
+        net(x).wait_to_read()
+    profiler.stop()
+    stats = profiler.get_device_op_stats()
+    assert stats, "no device op events parsed from xplane"
+    table = profiler.dumps()
+    assert "Device op" in table
+    # the hybridized Dense step must surface its matmul on-device
+    assert any("dot" in k or "fusion" in k for k in stats), sorted(stats)[:10]
+
+
+def test_profiler_device_memory_info():
+    from mxnet_tpu import profiler
+
+    mem = profiler.device_memory_info()
+    assert isinstance(mem, dict)  # CPU backend: empty; TPU: has peaks

@@ -135,3 +135,78 @@ def test_dist_sync_multiprocess_launcher():
         capture_output=True, text=True, timeout=240, env=env)
     assert res.returncode == 0, res.stdout + res.stderr
     assert res.stdout.count("dist_sync kvstore OK") == 3
+
+
+# -- gradient compression (reference: gradient_compression.h 2-bit/1-bit
+#    with error feedback; kvstore.h:86 SetGradientCompression) --------------
+@pytest.mark.parametrize("ctype", ["bf16", "int8", "2bit"])
+def test_gradient_compression_error_feedback_unbiased(ctype):
+    """Residual error feedback: the SUM of compressed contributions over
+    many rounds converges to the sum of the raw gradients."""
+    kv = kvstore.create("local")
+    # 2bit sends at most ±threshold per round, so the threshold must
+    # dominate the per-round gradient magnitude to stay unbiased
+    # (reference tunes this the same way)
+    kv.set_gradient_compression({"type": ctype, "threshold": 0.2})
+    g = onp.random.RandomState(0).randn(64).astype("float32") * 0.03
+    total = onp.zeros_like(g)
+    rounds = 50
+    for _ in range(rounds):
+        out = np.zeros((64,))
+        kv.pushpull("w", [np.array(g), np.array(g)], out=out)
+        total += out.asnumpy()
+    want = 2 * g * rounds
+    # error feedback keeps the long-run average unbiased: the residual
+    # bounds the gap by one round's worth of quantization error
+    err = onp.abs(total - want).max() / (onp.abs(want).max() + 1e-9)
+    assert err < 0.1, err
+
+
+def test_gradient_compression_rejects_unknown():
+    kv = kvstore.create("local")
+    with pytest.raises(MXNetError):
+        kv.set_gradient_compression({"type": "4bit"})
+
+
+def test_compressed_grad_mlp_converges():
+    """VERDICT #8 done-criterion: MLP trains to convergence with compressed
+    gradient aggregation through kvstore pushpull (two simulated workers)."""
+    onp.random.seed(1)
+    net = mx.gluon.nn.Sequential()
+    net.add(mx.gluon.nn.Dense(32, activation="relu", in_units=10))
+    net.add(mx.gluon.nn.Dense(2, in_units=32))
+    net.initialize()
+    kv = kvstore.create("device")
+    kv.set_gradient_compression({"type": "int8"})
+    params = list(net.collect_params().values())
+    opt = optimizer.SGD(learning_rate=0.5)
+    from mxnet_tpu.optimizer import get_updater
+
+    updater = get_updater(opt)
+    xs = onp.random.randn(64, 10).astype("float32")
+    w_true = onp.random.randn(10, 2).astype("float32")
+    ys = (xs @ w_true).argmax(1).astype("float32")
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for step in range(40):
+        half = 32
+        grads_per_worker = []
+        for w in range(2):
+            xb = np.array(xs[w * half:(w + 1) * half])
+            yb = np.array(ys[w * half:(w + 1) * half])
+            for p in params:
+                p.zero_grad()
+            with mx.autograd.record():
+                loss = loss_fn(net(xb), yb).mean()
+            loss.backward()
+            grads_per_worker.append([np.array(p.grad().asnumpy())
+                                     for p in params])
+            losses.append(float(loss.asnumpy()))
+        for i, p in enumerate(params):
+            red = np.zeros(p.data().shape)
+            kv.pushpull(f"p{i}",
+                        [grads_per_worker[0][i], grads_per_worker[1][i]],
+                        out=red)
+            updater(i, red / 2, p.data())
+    assert onp.mean(losses[-4:]) < onp.mean(losses[:4]) * 0.6, \
+        (onp.mean(losses[:4]), onp.mean(losses[-4:]))

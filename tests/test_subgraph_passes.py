@@ -1,0 +1,140 @@
+"""The built-in "tpu" subgraph backend: attention fusion
+(reference analog: src/operator/subgraph oneDNN fusion properties +
+HybridBlock.optimize_for, block.py optimize_for → MXOptimizeForBackend)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+from mxnet_tpu.symbol.symbol import topo_sort
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _flash_count(sym):
+    return sum(1 for n in topo_sort(sym._entries)
+               if n.op is not None and n.op.name == "flash_attention")
+
+
+class _ManualAttention(mx.gluon.HybridBlock):
+    """Attention written out long-hand — the pattern the pass must find."""
+
+    def __init__(self, style="div"):
+        super().__init__()
+        self.style = style
+
+    def forward(self, q, k, v):
+        kt = np.swapaxes(k, -1, -2)
+        logits = np.matmul(q, kt)
+        d = q.shape[-1]
+        if self.style == "div":
+            logits = logits / (d ** 0.5)
+        elif self.style == "mul":
+            logits = logits * (1.0 / d ** 0.5)
+        w = npx.softmax(logits, axis=-1)
+        return np.matmul(w, v)
+
+
+@pytest.mark.parametrize("style", ["div", "mul", "none"])
+def test_attention_pattern_rewritten(style):
+    """optimize_for('tpu') rewrites matmul→scale→softmax→matmul to ONE
+    flash_attention node, numerics preserved."""
+    B, H, T, D = 2, 2, 8, 4
+    rng = onp.random.RandomState(0)
+    q = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+    k = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+    v = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+
+    net = _ManualAttention(style)
+    want = net(q, k, v).asnumpy()  # eager, unfused
+
+    net.optimize_for(q, k, v, backend="tpu")
+    got = net(q, k, v).asnumpy()
+    assert_almost_equal(got, want, rtol=2e-3, atol=2e-4)
+
+    # the compiled graph must contain the fused op
+    (cop, _, _), = net._cached.values()
+    assert _flash_count(cop.sym) == 1, \
+        [n.op.name for n in topo_sort(cop.sym._entries) if n.op]
+
+
+def test_attention_fusion_via_symbol_api():
+    """sym.optimize_for('tpu') — the symbolic route."""
+    from mxnet_tpu import sym as S
+
+    q = S.var("q")
+    k = S.var("k")
+    v = S.var("v")
+    logits = S.matmul(q, S.swapaxes(k, axis1=-1, axis2=-2)) * 0.125
+    w = S.softmax(logits, axis=-1)
+    out = S.matmul(w, v)
+    fused = out.optimize_for("tpu")
+    assert _flash_count(fused) == 1
+
+
+def test_no_false_positive_when_weights_reused():
+    """If the softmax output has another consumer the pattern must NOT
+    fuse (the weights are observable)."""
+    from mxnet_tpu import sym as S
+
+    q = S.var("q")
+    k = S.var("k")
+    v = S.var("v")
+    w = S.softmax(S.matmul(q, S.swapaxes(k, axis1=-1, axis2=-2)), axis=-1)
+    out = S.Group([S.matmul(w, v), w])  # w escapes
+    fused = out.optimize_for("tpu")
+    assert _flash_count(fused) == 0
+
+
+def test_plain_matmul_not_rewritten():
+    from mxnet_tpu import sym as S
+
+    a = S.var("a")
+    b = S.var("b")
+    out = S.matmul(a, b)
+    fused = out.optimize_for("tpu")
+    assert _flash_count(fused) == 0
+
+
+def test_fused_attention_gradients_match():
+    """Backward through the fused graph matches the unfused eager grads."""
+    B, H, T, D = 1, 2, 8, 4
+    rng = onp.random.RandomState(1)
+    qv = rng.randn(B, H, T, D).astype(onp.float32)
+    kv = rng.randn(B, H, T, D).astype(onp.float32)
+    vv = rng.randn(B, H, T, D).astype(onp.float32)
+
+    def run(fused):
+        q = np.array(qv); k = np.array(kv); v = np.array(vv)
+        for a in (q, k, v):
+            a.attach_grad()
+        net = _ManualAttention("div")
+        if fused:
+            net.optimize_for(np.array(qv), np.array(kv), np.array(vv),
+                             backend="tpu")
+        with mx.autograd.record():
+            out = net(q, k, v)
+            loss = (out * out).sum()
+        loss.backward()
+        return [a.grad.asnumpy() for a in (q, k, v)]
+
+    g0 = run(False)
+    g1 = run(True)
+    for a, b in zip(g0, g1):
+        assert_almost_equal(a, b, rtol=5e-3, atol=5e-4)
+
+
+def test_rank3_headless_attention_fuses_and_runs():
+    """A 3-D (B, T, D) attention chain fuses and still executes (the
+    flash_attention op lifts headless operands to 4-D internally)."""
+    B, T, D = 2, 8, 4
+    rng = onp.random.RandomState(5)
+    q = np.array(rng.randn(B, T, D).astype(onp.float32))
+    k = np.array(rng.randn(B, T, D).astype(onp.float32))
+    v = np.array(rng.randn(B, T, D).astype(onp.float32))
+    net = _ManualAttention("div")
+    want = net(q, k, v).asnumpy()
+    net.optimize_for(q, k, v, backend="tpu")
+    got = net(q, k, v).asnumpy()
+    assert_almost_equal(got, want, rtol=2e-3, atol=2e-4)
+    (cop, _, _), = net._cached.values()
+    assert _flash_count(cop.sym) == 1
