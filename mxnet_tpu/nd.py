@@ -169,3 +169,26 @@ def load(fname):
         if keys and keys[0].startswith("__list__"):
             return [NDArray(z[f"__list__{i}"]) for i in range(len(keys))]
         return {k: NDArray(z[k]) for k in keys}
+
+
+# -- generated-wrapper parity: resolve ANY registered op lazily ------------
+# (reference: python/mxnet/ndarray op wrappers generated from the C op
+# registry at import; here module __getattr__ resolves from ops.registry)
+def __getattr__(name):
+    from .ops.registry import _OPS, apply_op
+    from .symbol import _LEGACY_NAMES
+
+    op_name = _LEGACY_NAMES.get(name, name)
+    if op_name not in _OPS:
+        raise AttributeError(f"module 'mxnet_tpu.nd' has no attribute "
+                             f"{name!r}")
+
+    def wrapper(*inputs, **attrs):
+        out = attrs.pop("out", None)
+        arrs = [x if isinstance(x, NDArray) else
+                (NDArray(x) if hasattr(x, "shape") else x) for x in inputs]
+        return apply_op(op_name, *arrs, out=out, **attrs)
+
+    wrapper.__name__ = name
+    globals()[name] = wrapper
+    return wrapper

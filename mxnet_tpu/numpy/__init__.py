@@ -758,3 +758,42 @@ def percentile(a, q, axis=None, out=None, overwrite_input=None,
                interpolation="linear", keepdims=False):
     return _op("percentile", _as_nd(a), q=_norm_q(q), axis=_ax(axis),
                method=interpolation or "linear", keepdims=keepdims, out=out)
+
+
+# numpy-parity stragglers over newly registered ops
+def diagflat(v, k=0):
+    return _op("diagflat", _as_nd(v), k=k)
+
+
+def fill_diagonal(a, val, wrap=False):
+    """In place like numpy: mutates ``a`` and returns None."""
+    if _onp.isscalar(val):
+        res = _op("fill_diagonal", _as_nd(a), val=val, wrap=wrap)
+    else:
+        res = _op("fill_diagonal", _as_nd(a), _as_nd(val), wrap=wrap)
+    a._set_data(res._data)
+
+
+def rollaxis(a, axis, start=0):
+    return _op("rollaxis", _as_nd(a), axis=axis, start=start)
+
+
+def polyval(p, x):
+    return _op("polyval", _as_nd(p), _as_nd(x))
+
+
+def blackman(M, dtype=None):
+    return _op("blackman", M=int(M))
+
+
+def hamming(M, dtype=None):
+    return _op("hamming", M=int(M))
+
+
+def hanning(M, dtype=None):
+    return _op("hanning", M=int(M))
+
+
+def tril_indices(n, k=0, m=None):
+    return _op("tril_indices", n=int(n), k=int(k),
+               m=int(m) if m is not None else None)

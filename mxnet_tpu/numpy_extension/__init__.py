@@ -371,3 +371,22 @@ def moments(data, axes=None, keepdims=False):
     return _op("moments", _nd(data),
                axes=tuple(axes) if axes is not None else None,
                keepdims=keepdims)
+
+
+# -- lazily resolve any remaining registered op (generated-wrapper parity) --
+def __getattr__(name):
+    from ..ops.registry import _OPS, apply_op
+
+    if name not in _OPS:
+        raise AttributeError(f"module 'mxnet_tpu.numpy_extension' has no "
+                             f"attribute {name!r}")
+
+    def wrapper(*inputs, **attrs):
+        out = attrs.pop("out", None)
+        arrs = [_nd(x) if hasattr(x, "shape") or isinstance(x, (list, tuple))
+                else x for x in inputs]
+        return apply_op(name, *arrs, out=out, **attrs)
+
+    wrapper.__name__ = name
+    globals()[name] = wrapper
+    return wrapper

@@ -5,5 +5,8 @@ from . import nn  # noqa: F401 — registers NN ops
 from . import indexing  # noqa: F401 — registers slice/scatter ops
 from . import rnn  # noqa: F401 — registers the fused scan RNN op
 from . import vision  # noqa: F401 — registers detection/resize/ROI ops
+from . import extra  # noqa: F401 — legacy tensor/transformer/multibox ops
+from . import linalg_legacy  # noqa: F401 — mx.nd.linalg_* family
+from . import optimizer_ops  # noqa: F401 — fused update ops incl. sparse
 
 __all__ = ["Op", "register", "get_op", "list_ops", "invoke", "apply_op"]

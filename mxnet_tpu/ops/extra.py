@@ -1,0 +1,545 @@
+"""Op-surface breadth: legacy tensor ops, transformer projection ops,
+MultiBox detection trio, window functions, and numpy-parity stragglers.
+
+Reference homes: src/operator/tensor/ (batch_dot dot.cc, reverse, depth/
+space ops, khatri_rao la_op.cc), src/operator/contrib/transformer.cc
+(interleaved attention matmuls), src/operator/contrib/multibox_*.cc (SSD
+anchor machinery), src/operator/nn/im2col, src/operator/numpy/ window fns.
+All are pure XLA lowerings — static shapes, MXU-friendly batched matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register
+
+__all__ = []
+
+
+# -- batched / structured matmuls -------------------------------------------
+@register("batch_dot")
+def _batch_dot(transpose_a=False, transpose_b=False):
+    def f(a, b):
+        x = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        y = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        return jnp.matmul(x, y)
+
+    return f
+
+
+@register("khatri_rao")
+def _khatri_rao():
+    def f(*mats):
+        out = mats[0]
+        for m in mats[1:]:
+            out = (out[:, None, :] * m[None, :, :]).reshape(
+                out.shape[0] * m.shape[0], -1)
+        return out
+
+    return f
+
+
+# transformer fused projections (reference: transformer.cc
+# _contrib_interleaved_matmul_selfatt_qk/valatt, encdec variants). Layout:
+# queries_keys_values (T, B, 3*H*D) interleaved per head.
+@register("interleaved_matmul_selfatt_qk")
+def _imm_selfatt_qk(heads=1):
+    def f(qkv):
+        t, b, e3 = qkv.shape
+        d = e3 // (3 * heads)
+        r = qkv.reshape(t, b, heads, 3, d)
+        q = r[..., 0, :].transpose(1, 2, 0, 3)  # (B, H, T, D)
+        k = r[..., 1, :].transpose(1, 2, 0, 3)
+        scale = 1.0 / (d ** 0.5)
+        out = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k)
+        return out.reshape(b * heads, t, t)
+
+    return f
+
+
+@register("interleaved_matmul_selfatt_valatt")
+def _imm_selfatt_valatt(heads=1):
+    def f(qkv, att):
+        t, b, e3 = qkv.shape
+        d = e3 // (3 * heads)
+        r = qkv.reshape(t, b, heads, 3, d)
+        v = r[..., 2, :].transpose(1, 2, 0, 3)          # (B, H, T, D)
+        w = att.reshape(b, heads, t, t)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+        return out.transpose(2, 0, 1, 3).reshape(t, b, heads * d)
+
+    return f
+
+
+@register("interleaved_matmul_encdec_qk")
+def _imm_encdec_qk(heads=1):
+    def f(q_proj, kv_proj):
+        tq, b, e = q_proj.shape
+        d = e // heads
+        tk = kv_proj.shape[0]
+        q = q_proj.reshape(tq, b, heads, d).transpose(1, 2, 0, 3)
+        kv = kv_proj.reshape(tk, b, heads, 2, d)
+        k = kv[..., 0, :].transpose(1, 2, 0, 3)
+        scale = 1.0 / (d ** 0.5)
+        out = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k)
+        return out.reshape(b * heads, tq, tk)
+
+    return f
+
+
+@register("interleaved_matmul_encdec_valatt")
+def _imm_encdec_valatt(heads=1):
+    def f(kv_proj, att):
+        tk, b, e2 = kv_proj.shape
+        d = e2 // (2 * heads)
+        kv = kv_proj.reshape(tk, b, heads, 2, d)
+        v = kv[..., 1, :].transpose(1, 2, 0, 3)
+        tq = att.shape[1]
+        w = att.reshape(b, heads, tq, tk)
+        out = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+        return out.transpose(2, 0, 1, 3).reshape(tq, b, heads * d)
+
+    return f
+
+
+# -- layout ops -------------------------------------------------------------
+@register("depth_to_space")
+def _depth_to_space(block_size=2):
+    s = block_size
+
+    def f(x):
+        n, c, h, w = x.shape
+        r = x.reshape(n, s, s, c // (s * s), h, w)
+        return r.transpose(0, 3, 4, 1, 5, 2).reshape(
+            n, c // (s * s), h * s, w * s)
+
+    return f
+
+
+@register("space_to_depth")
+def _space_to_depth(block_size=2):
+    s = block_size
+
+    def f(x):
+        n, c, h, w = x.shape
+        r = x.reshape(n, c, h // s, s, w // s, s)
+        return r.transpose(0, 3, 5, 1, 2, 4).reshape(
+            n, c * s * s, h // s, w // s)
+
+    return f
+
+
+@register("im2col")
+def _im2col(kernel=(3, 3), stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Unfold conv patches to columns (reference: src/operator/nn/im2col).
+    (N, C, H, W) → (N, C*kh*kw, L)."""
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+
+    def f(x):
+        n, c, h, w = x.shape
+        xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        cols = []
+        for i in range(kh):
+            for j in range(kw):
+                patch = xp[:, :, i * dh:i * dh + oh * sh:sh,
+                           j * dw:j * dw + ow * sw:sw]
+                cols.append(patch.reshape(n, c, oh * ow))
+        col = jnp.stack(cols, axis=2)  # (N, C, kh*kw, L)
+        return col.reshape(n, c * kh * kw, oh * ow)
+
+    return f
+
+
+@register("col2im")
+def _col2im(output_size=(4, 4), kernel=(3, 3), stride=(1, 1), dilate=(1, 1),
+            pad=(0, 0)):
+    """Fold columns back to an image, summing overlaps (im2col's adjoint)."""
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    H, W = output_size
+
+    def f(col):
+        n = col.shape[0]
+        c = col.shape[1] // (kh * kw)
+        oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        colr = col.reshape(n, c, kh * kw, oh, ow)
+        out = jnp.zeros((n, c, H + 2 * ph, W + 2 * pw), col.dtype)
+        idx = 0
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh:i * dh + oh * sh:sh,
+                             j * dw:j * dw + ow * sw:sw].add(
+                    colr[:, :, idx])
+                idx += 1
+        return out[:, :, ph:ph + H, pw:pw + W]
+
+    return f
+
+
+# -- misc tensor ops --------------------------------------------------------
+@register("reverse")
+def _reverse(axis=0):
+    ax = axis
+
+    def f(x):
+        return jnp.flip(x, axis=ax)
+
+    return f
+
+
+@register("batch_take")
+def _batch_take():
+    def f(x, idx):
+        return jnp.take_along_axis(
+            x, idx.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+
+    return f
+
+
+@register("argmax_channel")
+def _argmax_channel():
+    def f(x):
+        return jnp.argmax(x, axis=1).astype(x.dtype)
+
+    return f
+
+
+@register("shape_array", differentiable=False)
+def _shape_array():
+    def f(x):
+        return jnp.asarray(x.shape, jnp.int64)
+
+    return f
+
+
+@register("size_array", differentiable=False)
+def _size_array():
+    def f(x):
+        return jnp.asarray([x.size], jnp.int64)
+
+    return f
+
+
+@register("arange_like", differentiable=False)
+def _arange_like(start=0.0, step=1.0, axis=None):
+    def f(x):
+        if axis is None:
+            n = x.size
+            return (start + step * jnp.arange(n)).reshape(x.shape).astype(
+                x.dtype)
+        n = x.shape[axis]
+        return (start + step * jnp.arange(n)).astype(x.dtype)
+
+    return f
+
+
+@register("allclose", differentiable=False)
+def _allclose(rtol=1e-5, atol=1e-8, equal_nan=False):
+    def f(a, b):
+        return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan).reshape(())
+
+    return f
+
+
+@register("index_copy")
+def _index_copy():
+    """Copy rows of new_tensor into old_tensor at index (reference:
+    _contrib_index_copy)."""
+    def f(old, index, new):
+        return old.at[index.astype(jnp.int32)].set(new)
+
+    return f
+
+
+@register("quadratic")
+def _quadratic(a=0.0, b=0.0, c=0.0):
+    def f(x):
+        return a * x * x + b * x + c
+
+    return f
+
+
+@register("softmin")
+def _softmin(axis=-1):
+    def f(x):
+        return jax.nn.softmax(-x, axis=axis)
+
+    return f
+
+
+@register("masked_log_softmax")
+def _masked_log_softmax(axis=-1):
+    def f(x, mask):
+        z = jnp.where(mask.astype(bool), x, -jnp.inf)
+        out = jax.nn.log_softmax(z, axis=axis)
+        return jnp.where(mask.astype(bool), out, -jnp.inf)
+
+    return f
+
+
+@register("softmax_cross_entropy")
+def _softmax_cross_entropy():
+    def f(data, label):
+        logp = jax.nn.log_softmax(data, axis=-1)
+        picked = jnp.take_along_axis(
+            logp, label.astype(jnp.int32)[:, None], axis=-1)
+        return -picked.sum().reshape((1,))
+
+    return f
+
+
+@register("amp_cast")
+def _amp_cast(dtype="float16"):
+    import numpy as onp
+
+    target = jnp.bfloat16 if dtype == "bfloat16" else onp.dtype(dtype)
+
+    def f(x):
+        return x.astype(target)
+
+    return f
+
+
+@register("amp_multicast")
+def _amp_multicast(num_outputs=1, cast_narrow=False):
+    def f(*xs):
+        dts = [x.dtype for x in xs]
+        widths = [jnp.dtype(d).itemsize for d in dts]
+        pick = min(range(len(xs)), key=lambda i: widths[i]) if cast_narrow \
+            else max(range(len(xs)), key=lambda i: widths[i])
+        return tuple(x.astype(dts[pick]) for x in xs)
+
+    return f
+
+
+@register("bipartite_matching", nout=2, differentiable=False)
+def _bipartite_matching(threshold=0.5, is_ascend=False, topk=-1):
+    """Greedy bipartite matching over a score matrix (reference:
+    _contrib_bipartite_matching, bounding_box.cc): rows claim their best
+    column, best-scoring rows win conflicts. Static-shape greedy sweep."""
+    def match_one(score):
+        n_row, n_col = score.shape
+        order = jnp.argsort(-score.max(axis=1) if not is_ascend
+                            else score.min(axis=1))
+        row_match = jnp.full((n_row,), -1, jnp.int32)
+        col_used = jnp.zeros((n_col,), bool)
+
+        def body(i, carry):
+            rm, cu = carry
+            r = order[i]
+            s = jnp.where(cu, -jnp.inf if not is_ascend else jnp.inf,
+                          score[r])
+            c = jnp.argmax(s) if not is_ascend else jnp.argmin(s)
+            ok = (score[r, c] >= threshold) if not is_ascend else \
+                (score[r, c] <= threshold)
+            rm = rm.at[r].set(jnp.where(ok, c.astype(jnp.int32), -1))
+            cu = cu.at[c].set(cu[c] | ok)
+            return rm, cu
+
+        limit = n_row if topk <= 0 else min(topk, n_row)
+        row_match, col_used = jax.lax.fori_loop(0, limit, body,
+                                                (row_match, col_used))
+        col_match = jnp.full((n_col,), -1, jnp.int32)
+        rows = jnp.arange(n_row, dtype=jnp.int32)
+        valid = row_match >= 0
+        col_match = col_match.at[jnp.where(valid, row_match, n_col)].set(
+            jnp.where(valid, rows, -1), mode="drop")
+        return row_match.astype(score.dtype), col_match.astype(score.dtype)
+
+    def f(score):
+        if score.ndim == 2:
+            return match_one(score)
+        return jax.vmap(match_one)(score)
+
+    return f
+
+
+# -- MultiBox (SSD legacy trio — reference: multibox_prior.cc,
+#    multibox_target.cc, multibox_detection.cc) -----------------------------
+@register("multibox_prior", differentiable=False)
+def _multibox_prior(sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1, -1),
+                    offsets=(0.5, 0.5)):
+    def f(data):
+        h, w = data.shape[-2], data.shape[-1]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h) + offsets[0]) * step_y
+        cx = (jnp.arange(w) + offsets[1]) * step_x
+        cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # (h,w,2)
+        anchors = []
+        # reference enumerates (size[0], ratios...) + (sizes[1:], ratio[0])
+        combos = [(sizes[0], r) for r in ratios] + \
+                 [(s, ratios[0]) for s in sizes[1:]]
+        for s, r in combos:
+            aw = s * (r ** 0.5) / 2
+            ah = s / (r ** 0.5) / 2
+            box = jnp.stack([cyx[..., 1] - aw, cyx[..., 0] - ah,
+                             cyx[..., 1] + aw, cyx[..., 0] + ah], -1)
+            anchors.append(box)
+        out = jnp.stack(anchors, 2).reshape(1, -1, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out
+
+    return f
+
+
+@register("multibox_target", nout=3, differentiable=False)
+def _multibox_target(overlap_threshold=0.5, negative_mining_ratio=-1.0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to labels, emit (loc_target, loc_mask, cls_target).
+    label: (B, M, 5) rows [cls, x1, y1, x2, y2], -1 padded."""
+    from .vision import _pair_iou
+
+    var = jnp.asarray(variances)
+
+    def one(anchors, label):
+        valid = label[:, 0] >= 0
+        gt = label[:, 1:5]
+        iou = _pair_iou(anchors, gt)               # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        pos = best_iou > overlap_threshold
+        # reference two-stage matching (multibox_target.cc): every valid
+        # ground truth claims its best-IoU anchor unconditionally, THEN
+        # the threshold stage adds the rest — without this, a gt whose
+        # best anchor is below threshold would go untrained
+        m = gt.shape[0]
+        best_anchor = jnp.argmax(iou, axis=0)       # (M,)
+        best_gt = best_gt.at[best_anchor].set(
+            jnp.where(valid, jnp.arange(m), best_gt[best_anchor]))
+        pos = pos.at[best_anchor].set(
+            jnp.where(valid, True, pos[best_anchor]))
+        g = gt[best_gt]
+        a_xy = (anchors[:, :2] + anchors[:, 2:]) / 2
+        a_wh = jnp.maximum(anchors[:, 2:] - anchors[:, :2], 1e-9)
+        g_xy = (g[:, :2] + g[:, 2:]) / 2
+        g_wh = jnp.maximum(g[:, 2:] - g[:, :2], 1e-9)
+        t = jnp.concatenate([(g_xy - a_xy) / a_wh / var[:2],
+                             jnp.log(g_wh / a_wh) / var[2:]], -1)
+        loc_t = jnp.where(pos[:, None], t, 0.0).reshape(-1)
+        loc_m = jnp.where(pos[:, None],
+                          jnp.ones_like(t), 0.0).reshape(-1)
+        cls_t = jnp.where(pos, label[best_gt, 0] + 1, 0.0)
+        return loc_t, loc_m, cls_t
+
+    def f(anchors, cls_preds, label):
+        anc = anchors.reshape(-1, 4)
+        lt, lm, ct = jax.vmap(lambda lb: one(anc, lb))(label)
+        return lt, lm, ct
+
+    return f
+
+
+@register("multibox_detection", differentiable=False)
+def _multibox_detection(clip=True, threshold=0.01, nms_threshold=0.5,
+                        force_suppress=False, nms_topk=-1,
+                        variances=(0.1, 0.1, 0.2, 0.2)):
+    """Decode predictions + per-class NMS → (B, N, 6) rows
+    [cls, score, x1, y1, x2, y2], invalid rows -1."""
+    from .registry import get_op
+
+    var = variances
+
+    def f(cls_prob, loc_pred, anchors):
+        b, nc, n = cls_prob.shape
+        anc = anchors.reshape(-1, 4)
+        a_xy = (anc[:, :2] + anc[:, 2:]) / 2
+        a_wh = jnp.maximum(anc[:, 2:] - anc[:, :2], 1e-9)
+        loc = loc_pred.reshape(b, n, 4)
+        v = jnp.asarray(var)
+        xy = loc[..., :2] * v[:2] * a_wh + a_xy
+        wh = jnp.exp(loc[..., 2:] * v[2:]) * a_wh / 2
+        boxes = jnp.concatenate([xy - wh, xy + wh], -1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        score = cls_prob[:, 1:, :]                # drop background row
+        cls_id = jnp.argmax(score, axis=1).astype(cls_prob.dtype)
+        best = jnp.max(score, axis=1)
+        keep = best > threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[..., None],
+             jnp.where(keep, best, -1.0)[..., None], boxes], -1)
+        nms = get_op("box_nms").fn(
+            overlap_thresh=nms_threshold, valid_thresh=threshold,
+            topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+            force_suppress=force_suppress)
+        return nms(rows)
+
+    return f
+
+
+# -- window functions + numpy stragglers ------------------------------------
+register("blackman", lambda M=10, **a: (lambda: jnp.blackman(M)))
+register("hamming", lambda M=10, **a: (lambda: jnp.hamming(M)))
+register("hanning", lambda M=10, **a: (lambda: jnp.hanning(M)))
+
+
+@register("diagflat")
+def _diagflat(k=0):
+    def f(x):
+        return jnp.diagflat(x, k)
+
+    return f
+
+
+@register("fill_diagonal")
+def _fill_diagonal(val=None, wrap=False):
+    """numpy.fill_diagonal semantics over flat strides: for 2-D the
+    diagonal is ``a.flat[:end:ncols+1]`` with ``end = ncols*ncols`` for
+    tall matrices unless ``wrap``; val may be a scalar attr or an array
+    operand (tiled like numpy)."""
+    def f(x, *val_arr):
+        v = val_arr[0] if val_arr else val
+        if x.ndim != 2:
+            # >2-D requires equal dims (numpy contract)
+            n = x.shape[0]
+            idx = (jnp.arange(n),) * x.ndim
+            return x.at[idx].set(v if not val_arr else
+                                 jnp.resize(v, (n,)))
+        rows, cols = x.shape
+        step = cols + 1
+        end = None if (wrap or rows <= cols) else cols * cols
+        flat = x.reshape(-1)
+        pos = jnp.arange(flat.shape[0])[:end:step]
+        vals = jnp.resize(v, pos.shape) if val_arr else \
+            jnp.full(pos.shape, v, x.dtype)
+        return flat.at[pos].set(vals.astype(x.dtype)).reshape(x.shape)
+
+    return f
+
+
+@register("rollaxis")
+def _rollaxis(axis=0, start=0):
+    def f(x):
+        return jnp.rollaxis(x, axis, start)
+
+    return f
+
+
+@register("polyval")
+def _polyval():
+    def f(p, x):
+        return jnp.polyval(p, x)
+
+    return f
+
+
+@register("tril_indices", differentiable=False)
+def _tril_indices(n=1, k=0, m=None):
+    def f():
+        return tuple(jnp.tril_indices(n, k, m))
+
+    return f

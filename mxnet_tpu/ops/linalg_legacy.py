@@ -1,0 +1,211 @@
+"""Legacy `mx.nd.linalg_*` operator family (reference: src/operator/tensor/
+la_op.cc — gemm/gemm2/potrf/potri/trmm/trsm/syrk/syevd/gelqf/makediag/
+extractdiag/maketrian/extracttrian/sumlogdiag/inverse).
+
+XLA lowerings over jax.lax.linalg / jnp.linalg: batched by construction
+(leading dims broadcast), fp32 accumulation on the MXU for the matmul
+family. Ops are registered under the reference's exact names so symbolic
+scripts using `sym.linalg_gemm2(...)` port unchanged.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register("linalg_gemm")
+def _linalg_gemm(transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+                 axis=-2):
+    def f(a, b, c):
+        return alpha * jnp.matmul(_t(a, transpose_a), _t(b, transpose_b)) \
+            + beta * c
+
+    return f
+
+
+@register("linalg_gemm2")
+def _linalg_gemm2(transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    def f(a, b):
+        return alpha * jnp.matmul(_t(a, transpose_a), _t(b, transpose_b))
+
+    return f
+
+
+@register("linalg_potrf")
+def _linalg_potrf(lower=True):
+    def f(a):
+        ch = jnp.linalg.cholesky(a)
+        return ch if lower else jnp.swapaxes(ch, -1, -2)
+
+    return f
+
+
+@register("linalg_potri")
+def _linalg_potri(lower=True):
+    """Inverse from a Cholesky factor (reference: potri)."""
+    def f(l):  # noqa: E741 — reference operand name
+        lt = l if lower else jnp.swapaxes(l, -1, -2)
+        eye = jnp.broadcast_to(jnp.eye(lt.shape[-1], dtype=lt.dtype),
+                               lt.shape)
+        linv = jax.lax.linalg.triangular_solve(
+            lt, eye, left_side=True, lower=True)
+        return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+    return f
+
+
+@register("linalg_trmm")
+def _linalg_trmm(transpose=False, rightside=False, lower=True, alpha=1.0):
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        tri = _t(tri, transpose)
+        return alpha * (jnp.matmul(b, tri) if rightside
+                        else jnp.matmul(tri, b))
+
+    return f
+
+
+@register("linalg_trsm")
+def _linalg_trsm(transpose=False, rightside=False, lower=True, alpha=1.0):
+    def f(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        return alpha * jax.lax.linalg.triangular_solve(
+            tri, b, left_side=not rightside, lower=lower,
+            transpose_a=transpose)
+
+    return f
+
+
+@register("linalg_syrk")
+def _linalg_syrk(transpose=False, alpha=1.0):
+    def f(a):
+        return alpha * (jnp.matmul(_t(a, True), a) if transpose
+                        else jnp.matmul(a, _t(a, True)))
+
+    return f
+
+
+@register("linalg_syevd", nout=2)
+def _linalg_syevd():
+    def f(a):
+        w, v = jnp.linalg.eigh(a)
+        # reference returns (U, lambda) with rows of U the eigenvectors
+        return jnp.swapaxes(v, -1, -2), w
+
+    return f
+
+
+@register("linalg_gelqf", nout=2)
+def _linalg_gelqf():
+    """LQ factorization A = L Q (reference: gelqf) via QR of Aᵀ."""
+    def f(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2), mode="reduced")
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+    return f
+
+
+@register("linalg_makediag")
+def _linalg_makediag(offset=0):
+    def f(a):
+        return jax.vmap(lambda v: jnp.diagflat(v, offset))(
+            a.reshape(-1, a.shape[-1])).reshape(
+            a.shape[:-1] + (a.shape[-1] + abs(offset),
+                            a.shape[-1] + abs(offset))) \
+            if a.ndim > 1 else jnp.diagflat(a, offset)
+
+    return f
+
+
+@register("linalg_extractdiag")
+def _linalg_extractdiag(offset=0):
+    def f(a):
+        return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+    return f
+
+
+@register("linalg_maketrian")
+def _linalg_maketrian(offset=0, lower=True):
+    """Pack a vector into a triangular matrix (reference: maketrian).
+
+    Only the main-diagonal packing (offset=0) is implemented; a silent
+    wrong-size answer for banded offsets would be worse than an error."""
+    from ..base import MXNetError
+
+    if offset != 0:
+        raise MXNetError("linalg_maketrian: offset != 0 is not supported")
+
+    def f(a):
+        n_elem = a.shape[-1]
+        # n*(n+1)/2 = n_elem → n
+        n = int((-1 + (1 + 8 * n_elem) ** 0.5) / 2)
+        idx = jnp.tril_indices(n) if lower else jnp.triu_indices(n)
+
+        def pack(v):
+            m = jnp.zeros((n, n), a.dtype)
+            return m.at[idx].set(v)
+
+        flat = a.reshape(-1, n_elem)
+        return jax.vmap(pack)(flat).reshape(a.shape[:-1] + (n, n))
+
+    return f
+
+
+@register("linalg_extracttrian")
+def _linalg_extracttrian(offset=0, lower=True):
+    def f(a):
+        n = a.shape[-1]
+        idx = jnp.tril_indices(n, offset) if lower else \
+            jnp.triu_indices(n, offset)
+
+        def unpack(m):
+            return m[idx]
+
+        flat = a.reshape((-1,) + a.shape[-2:])
+        out = jax.vmap(unpack)(flat)
+        return out.reshape(a.shape[:-2] + (out.shape[-1],))
+
+    return f
+
+
+@register("linalg_sumlogdiag")
+def _linalg_sumlogdiag():
+    def f(a):
+        return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), -1)
+
+    return f
+
+
+@register("linalg_inverse")
+def _linalg_inverse():
+    def f(a):
+        return jnp.linalg.inv(a)
+
+    return f
+
+
+# non-symmetric eigen decompositions (CPU-only in XLA — the reference's
+# numpy parity surface; run them on host-backed arrays)
+@register("linalg_eig", nout=2, differentiable=False)
+def _linalg_eig():
+    def f(a):
+        return tuple(jnp.linalg.eig(a))
+
+    return f
+
+
+@register("linalg_eigvals", differentiable=False)
+def _linalg_eigvals():
+    def f(a):
+        return jnp.linalg.eigvals(a)
+
+    return f

@@ -15,6 +15,7 @@ import jax
 import numpy as onp
 
 __all__ = ["seed", "uniform", "normal", "randint", "randn", "rand",
+           "geometric", "binomial",
            "choice", "shuffle", "permutation", "multinomial", "bernoulli",
            "gamma", "beta", "exponential", "poisson", "laplace", "gumbel",
            "logistic", "pareto", "power", "rayleigh", "weibull", "chisquare",
@@ -236,3 +237,17 @@ def _f(dtype):
     return {"float32": onp.float32, "float64": onp.float32,
             "float16": onp.float16, "bfloat16": "bfloat16",
             "None": onp.float32}.get(d, onp.float32)
+
+
+def geometric(p=0.5, size=None, ctx=None):
+    """Number of Bernoulli(p) trials to first success (support {1, 2, ...})."""
+    import jax.numpy as jnp
+
+    u = jax.random.uniform(_next_key(), _shape(size), minval=1e-12)
+    data = jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(jnp.int32)
+    return _wrap(jnp.maximum(data, 1), ctx)
+
+
+def binomial(n=1, p=0.5, size=None, ctx=None):
+    data = jax.random.binomial(_next_key(), n, p, _shape(size) or None)
+    return _wrap(data, ctx)

@@ -188,3 +188,29 @@ def test_pad_meshgrid():
     r1, r2 = onp.meshgrid(onp.arange(3), onp.arange(2))
     assert_almost_equal(g1.astype("float32"), r1.astype("float32"))
     assert_almost_equal(g2.astype("float32"), r2.astype("float32"))
+
+
+def test_fill_diagonal_in_place_and_wrap():
+    """numpy contract: mutates in place, returns None; tall-matrix wrap."""
+    x = np.ones((3, 3))
+    ret = np.fill_diagonal(x, 0)
+    assert ret is None
+    assert_almost_equal(x.asnumpy(), onp.array(
+        [[0, 1, 1], [1, 0, 1], [1, 1, 0]], dtype="float32"))
+    # array val
+    y = np.ones((3, 3))
+    np.fill_diagonal(y, np.array([1.0, 2.0, 3.0]))
+    assert_almost_equal(onp.diag(y.asnumpy()), [1.0, 2.0, 3.0])
+    # tall without wrap: numpy stops after ncols*ncols flat elements
+    t = onp.ones((5, 2), "float32")
+    tw = np.array(t.copy())
+    np.fill_diagonal(tw, 0)
+    ref = t.copy()
+    onp.fill_diagonal(ref, 0)
+    assert_almost_equal(tw.asnumpy(), ref)
+    # tall with wrap
+    tw2 = np.array(t.copy())
+    np.fill_diagonal(tw2, 0, wrap=True)
+    ref2 = t.copy()
+    onp.fill_diagonal(ref2, 0, wrap=True)
+    assert_almost_equal(tw2.asnumpy(), ref2)

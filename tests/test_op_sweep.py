@@ -382,6 +382,179 @@ SPECS = {
              lambda a, b: onp.kron(a, b), True),
 }
 
+def _fill_diag_ref(x, val):
+    y = x.copy()
+    onp.fill_diagonal(y, val)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# specs for the breadth tiers (ops/extra.py, ops/linalg_legacy.py,
+# ops/optimizer_ops.py)
+# ---------------------------------------------------------------------------
+def _tri_vec(n):
+    return _f(n * (n + 1) // 2)
+
+
+SPECS.update({
+    # extra.py — tensor / transformer / multibox
+    "batch_dot": (lambda: [_f(2, 3, 4), _f(2, 4, 5)], {},
+                  lambda a, b: onp.matmul(a, b), True),
+    "khatri_rao": (lambda: [_f(2, 4), _f(3, 4)], {},
+                   lambda a, b: onp.stack(
+                       [onp.kron(a[:, i], b[:, i])
+                        for i in range(4)], 1).reshape(6, 4), True),
+    "interleaved_matmul_selfatt_qk": (
+        lambda: [_f(6, 2, 3 * 2 * 4)], {"heads": 2}, None, True),
+    "interleaved_matmul_selfatt_valatt": (
+        lambda: [_f(6, 2, 3 * 2 * 4), _f(4, 6, 6)], {"heads": 2}, None,
+        True),
+    "interleaved_matmul_encdec_qk": (
+        lambda: [_f(5, 2, 2 * 4), _f(7, 2, 2 * 2 * 4)], {"heads": 2},
+        None, True),
+    "interleaved_matmul_encdec_valatt": (
+        lambda: [_f(7, 2, 2 * 2 * 4), _f(4, 5, 7)], {"heads": 2}, None,
+        True),
+    "depth_to_space": (lambda: [_f(1, 8, 2, 3)], {"block_size": 2}, None,
+                       True),
+    "space_to_depth": (lambda: [_f(1, 2, 4, 6)], {"block_size": 2}, None,
+                       True),
+    "im2col": (lambda: [_f(1, 2, 5, 5)], {"kernel": (3, 3)}, None, True),
+    "col2im": (lambda: [_f(1, 2 * 9, 9)], {"output_size": (5, 5),
+                                           "kernel": (3, 3)}, None, True),
+    "reverse": (lambda: [_f(3, 4)], {"axis": 1},
+                lambda x: x[:, ::-1], True),
+    "batch_take": (lambda: [_f(3, 5), onp.array([0, 2, 4])], {}, None,
+                   False),
+    "argmax_channel": (lambda: [_f(3, 4)], {},
+                       lambda x: x.argmax(1).astype("float32"), False),
+    "shape_array": (lambda: [_f(3, 4)], {},
+                    lambda x: onp.array(x.shape), False),
+    "size_array": (lambda: [_f(3, 4)], {}, lambda x: onp.array([x.size]),
+                   False),
+    "arange_like": (lambda: [_f(3, 4)], {},
+                    lambda x: onp.arange(12.0).reshape(3, 4), False),
+    "allclose": (lambda: [_f(3, 4)] * 1 + [_f(3, 4)], {}, None, False),
+    "index_copy": (lambda: [_f(4, 3), onp.array([1, 3]), _f(2, 3)], {},
+                   None, False),
+    "quadratic": (lambda: [_f(3, 4)], {"a": 1.0, "b": 2.0, "c": 3.0},
+                  lambda x: x * x + 2 * x + 3, True),
+    "softmin": (lambda: [_f(3, 4)], {}, None, True),
+    "masked_log_softmax": (lambda: [_f(3, 5), RNG.rand(3, 5) > 0.3], {},
+                           None, False),
+    "softmax_cross_entropy": (lambda: [_f(4, 5),
+                                       onp.array([0., 1., 2., 3.])], {},
+                              None, False),
+    "amp_cast": (lambda: [_f(3, 4)], {"dtype": "bfloat16"}, None, False),
+    "amp_multicast": (lambda: [_f(3, 4), _f(3, 4)], {"num_outputs": 2},
+                      None, False),
+    "bipartite_matching": (lambda: [onp.abs(_f(4, 5))], {"threshold": 0.1},
+                           None, False),
+    "multibox_prior": (lambda: [_f(1, 3, 4, 4)],
+                       {"sizes": (0.5, 0.25), "ratios": (1.0, 2.0)}, None,
+                       False),
+    "multibox_target": (
+        lambda: [onp.abs(_f(1, 8, 4)),
+                 _f(1, 3, 8),
+                 onp.array([[[0, 0.1, 0.1, 0.6, 0.6],
+                             [1, 0.4, 0.4, 0.9, 0.9],
+                             [-1, 0, 0, 0, 0]]], "float32")], {}, None,
+        False),
+    "multibox_detection": (
+        lambda: [onp.abs(_f(1, 3, 8)), _f(1, 32),
+                 onp.abs(_f(1, 8, 4))], {}, None, False),
+    "blackman": (lambda: [], {"M": 8}, lambda: onp.blackman(8), False),
+    "hamming": (lambda: [], {"M": 8}, lambda: onp.hamming(8), False),
+    "hanning": (lambda: [], {"M": 8}, lambda: onp.hanning(8), False),
+    "diagflat": (lambda: [_f(4)], {}, lambda x: onp.diagflat(x), True),
+    "fill_diagonal": (lambda: [_f(4, 4)], {"val": 9.0},
+                      lambda x: _fill_diag_ref(x, 9.0), False),
+    "rollaxis": (lambda: [_f(2, 3, 4)], {"axis": 2},
+                 lambda x: onp.rollaxis(x, 2), True),
+    "polyval": (lambda: [_f(3), _f(4)], {},
+                lambda p, x: onp.polyval(p, x), True),
+    "tril_indices": (lambda: [], {"n": 4}, None, False),
+    # linalg_legacy.py
+    "linalg_gemm": (lambda: [_f(3, 4), _f(4, 5), _f(3, 5)],
+                    {"alpha": 2.0, "beta": 0.5},
+                    lambda a, b, c: 2.0 * a @ b + 0.5 * c, True),
+    "linalg_gemm2": (lambda: [_f(3, 4), _f(5, 4)], {"transpose_b": True},
+                     lambda a, b: a @ b.T, True),
+    "linalg_potrf": (lambda: [_spd(4)], {},
+                     lambda a: onp.linalg.cholesky(a), False),
+    "linalg_potri": (lambda: [onp.linalg.cholesky(_spd(4))], {}, None,
+                     False),
+    "linalg_trmm": (lambda: [_f(4, 4), _f(4, 3)], {},
+                    lambda a, b: onp.tril(a) @ b, True),
+    "linalg_trsm": (lambda: [_spd(4), _f(4, 3)], {},
+                    lambda a, b: onp.linalg.solve(onp.tril(a), b), False),
+    "linalg_syrk": (lambda: [_f(3, 4)], {},
+                    lambda a: a @ a.T, True),
+    "linalg_syevd": (lambda: [_spd(4)], {}, None, False),
+    "linalg_gelqf": (lambda: [_f(3, 5)], {}, None, False),
+    "linalg_makediag": (lambda: [_f(4)], {},
+                        lambda a: onp.diagflat(a), True),
+    "linalg_extractdiag": (lambda: [_f(4, 4)], {},
+                           lambda a: onp.diagonal(a), True),
+    "linalg_maketrian": (lambda: [_tri_vec(4)], {}, None, False),
+    "linalg_extracttrian": (lambda: [_f(4, 4)], {}, None, True),
+    "linalg_sumlogdiag": (lambda: [_spd(4)], {},
+                          lambda a: onp.log(onp.diag(a)).sum(), True),
+    "linalg_inverse": (lambda: [_spd(4)], {},
+                       lambda a: onp.linalg.inv(a), False),
+    "linalg_eig": (lambda: [_f(4, 4)], {}, None, False),
+    "linalg_eigvals": (lambda: [_f(4, 4)], {}, None, False),
+    # optimizer_ops.py — each checked against a hand-rolled numpy step
+    "sgd_update": (lambda: [_f(4), _f(4)], {"lr": 0.1, "wd": 0.01},
+                   lambda w, g: w - 0.1 * (g + 0.01 * w), False),
+    "sgd_mom_update": (lambda: [_f(4), _f(4), _f(4)],
+                       {"lr": 0.1, "momentum": 0.9}, None, False),
+    "nag_mom_update": (lambda: [_f(4), _f(4), _f(4)],
+                       {"lr": 0.1, "momentum": 0.9}, None, False),
+    "signsgd_update": (lambda: [_f(4), _f(4)], {"lr": 0.1},
+                       lambda w, g: w - 0.1 * onp.sign(g), False),
+    "signum_update": (lambda: [_f(4), _f(4), _f(4)],
+                      {"lr": 0.1, "momentum": 0.9}, None, False),
+    "adam_update": (lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4))],
+                    {"lr": 0.01}, None, False),
+    "adamw_update": (lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4))],
+                     {"lr": 0.01, "wd": 0.01}, None, False),
+    "adabelief_update": (lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4))],
+                         {"lr": 0.01}, None, False),
+    "ftml_update": (lambda: [_f(4), _f(4), onp.abs(_f(4)),
+                             onp.abs(_f(4)), _f(4)], {"lr": 0.01, "t": 2},
+                    None, False),
+    "ftrl_update": (lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4))],
+                    {"lr": 0.1}, None, False),
+    "rmsprop_update": (lambda: [_f(4), _f(4), onp.abs(_f(4))],
+                       {"lr": 0.01}, None, False),
+    "rmspropalex_update": (lambda: [_f(4), _f(4), onp.abs(_f(4)), _f(4),
+                                    _f(4)], {"lr": 0.01}, None, False),
+    "lamb_update_phase1": (lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4))],
+                           {"t": 1}, None, False),
+    "lamb_update_phase2": (lambda: [_f(4), _f(4), onp.array([1.0]),
+                                    onp.array([1.0])], {"lr": 0.01}, None,
+                           False),
+    "sparse_sgd_update": (lambda: [_f(6, 3), _f(2, 3),
+                                   onp.array([1, 4])], {"lr": 0.1}, None,
+                          False),
+    "sparse_adagrad_update": (
+        lambda: [_f(6, 3), onp.abs(_f(6, 3)), _f(2, 3),
+                 onp.array([1, 4])], {"lr": 0.1}, None, False),
+    "group_adagrad_update": (lambda: [_f(4, 3), onp.abs(_f(4)), _f(4, 3)],
+                             {"lr": 0.1}, None, False),
+    # interleaved reference convention: (w0, g0, w1, g1, ...)
+    "multi_sgd_update": (lambda: [_f(3), _f(3), _f(4), _f(4)],
+                         {"lrs": (0.1, 0.1), "wds": (0.0, 0.0),
+                          "num_weights": 2}, None, False),
+    "all_finite": (lambda: [_f(3, 4)], {},
+                   lambda x: onp.array(True), False),
+    "multi_all_finite": (lambda: [_f(3), _f(4)], {"num_arrays": 2}, None,
+                         False),
+})
+
+
+
 # ops proven in dedicated test files (sweep exemption must name the file)
 COVERED_ELSEWHERE = {
     "batch_norm": "test_operator_nn.py",
@@ -489,3 +662,41 @@ def test_indexing_ops_via_public_api():
                    {"spec": spec})
     ref[:, 0] += 1.0
     assert_almost_equal(y.asnumpy(), ref, rtol=1e-6)
+
+
+def test_sparse_adagrad_only_touches_active_rows():
+    """Reference row-sparse semantics (optimizer_op.cc sparse adagrad):
+    rows outside the gradient's index set must be bit-identical."""
+    w = _f(6, 3)
+    h = onp.abs(_f(6, 3))
+    g = _f(2, 3)
+    idx = onp.array([1, 4])
+    new_w, new_h = apply_op("sparse_adagrad_update", NDArray(w), NDArray(h),
+                            NDArray(g), NDArray(idx), lr=0.1)
+    nw, nh = new_w.asnumpy(), new_h.asnumpy()
+    untouched = [0, 2, 3, 5]
+    assert (nw[untouched] == w[untouched]).all()
+    assert (nh[untouched] == h[untouched]).all()
+    assert not (nw[[1, 4]] == w[[1, 4]]).all()
+    # touched-row math matches dense adagrad on those rows
+    hr = h[[1, 4]] + g * g
+    wr = w[[1, 4]] - 0.1 * g / (onp.sqrt(hr) + 1e-7)
+    assert_almost_equal(nw[[1, 4]], wr, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_update_op_matches_reference_formula():
+    """adam_update implements the reference's UNCORRECTED update
+    (optimizer_op.cc adam_update has no bias correction — the python
+    Optimizer layer applies it via rescaled lr)."""
+    w = _f(5)
+    g = _f(5)
+    mean0 = onp.zeros(5, "float32")
+    var0 = onp.zeros(5, "float32")
+    new_w, m, v = apply_op("adam_update", NDArray(w), NDArray(g),
+                           NDArray(mean0), NDArray(var0), lr=0.01)
+    m_ref = 0.1 * g
+    v_ref = 0.001 * g * g
+    w_ref = w - 0.01 * m_ref / (onp.sqrt(v_ref) + 1e-8)
+    assert_almost_equal(new_w.asnumpy(), w_ref, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(m.asnumpy(), m_ref, rtol=1e-5, atol=1e-7)
+    assert_almost_equal(v.asnumpy(), v_ref, rtol=1e-5, atol=1e-8)

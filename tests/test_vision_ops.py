@@ -199,3 +199,18 @@ def test_ssd_style_head_trains(hybridize):
         trainer.step(2)
         losses.append(float(loss.asnumpy()))
     assert losses[-1] < losses[0]
+
+
+def test_multibox_target_best_anchor_always_matches():
+    """Reference two-stage matching: each gt claims its best anchor even
+    below the IoU threshold (multibox_target.cc bipartite stage)."""
+    anchors = onp.array([[[0.2, 0.2, 0.55, 0.55],
+                          [0.6, 0.6, 0.9, 0.9]]], "float32")
+    # gt whose IoU with its best anchor is < 0.5
+    label = onp.array([[[0, 0.0, 0.0, 0.4, 0.4]]], "float32")
+    cls_preds = onp.zeros((1, 2, 2), "float32")
+    lt, lm, ct = npx.multibox_target(np.array(anchors),
+                                     np.array(cls_preds), np.array(label))
+    assert (lm.asnumpy() > 0).any()
+    assert ct.asnumpy()[0, 0] == 1  # anchor 0 assigned to class 0 (+1)
+    assert ct.asnumpy()[0, 1] == 0  # anchor 1 stays background
