@@ -1,6 +1,16 @@
 """Estimator: high-level fit loop (reference: gluon/contrib/estimator/
-estimator.py, Estimator.fit:327)."""
+estimator.py, Estimator.fit:327).
+
+Full reference lifecycle semantics: train AND val metric sets (val copied
+from train when absent), default-handler assembly (Stopping, Metric,
+Validation, Logging, GradientUpdate), priority-ordered event dispatch, and
+trainer stepping routed through GradientUpdateHandler (priority -2000,
+dispatched FIRST at batch_end like the reference) — a handler that must see
+raw gradients before the update declares a priority below -2000.
+"""
 from __future__ import annotations
+
+import copy
 
 from ....base import MXNetError
 from .... import autograd
@@ -8,23 +18,40 @@ from ....metric import EvalMetric, Loss as LossMetric, Accuracy
 from ..estimator.event_handler import (TrainBegin, TrainEnd, EpochBegin,
                                        EpochEnd, BatchBegin, BatchEnd,
                                        StoppingHandler, MetricHandler,
-                                       LoggingHandler)
+                                       ValidationHandler, LoggingHandler,
+                                       GradientUpdateHandler)
 
 __all__ = ["Estimator"]
 
 
+def _check_metrics(metrics):
+    if metrics is None:
+        return []
+    metrics = metrics if isinstance(metrics, list) else [metrics]
+    for m in metrics:
+        if not isinstance(m, EvalMetric):
+            raise MXNetError(f"metric {m!r} is not an EvalMetric")
+    return metrics
+
+
 class Estimator:
+    """Reference-parity train/eval harness over Gluon blocks."""
+
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
                  trainer=None, context=None, device=None):
         self.net = net
         self.loss = loss
         self.trainer = trainer
         self.context = device or context
-        self.train_metrics = train_metrics or [Accuracy()]
-        if not isinstance(self.train_metrics, list):
-            self.train_metrics = [self.train_metrics]
+        self.train_metrics = _check_metrics(train_metrics) or [Accuracy()]
+        self.val_metrics = _check_metrics(val_metrics) or [
+            copy.deepcopy(m) for m in self.train_metrics]
         self.train_loss_metric = LossMetric(name="train_loss")
+        self.val_loss_metric = LossMetric(name="val_loss")
+        self.max_epoch = None
+        self.max_batch = None
 
+    # -- data plumbing ------------------------------------------------------
     def _batch_fn(self, batch):
         data, label = batch[0], batch[1]
         return data, label
@@ -37,15 +64,41 @@ class Estimator:
         loss.backward()
         return data, label, pred, loss
 
+    # -- handler machinery --------------------------------------------------
+    @staticmethod
+    def _priority(handler):
+        return getattr(handler, "priority", 0)
+
+    def _assemble_handlers(self, event_handlers, val_data, epochs, batches):
+        handlers = list(event_handlers or [])
+        handlers.append(StoppingHandler(epochs, batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                [self.train_loss_metric] + self.train_metrics))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data=val_data,
+                                              eval_fn=self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=[self.train_loss_metric] + self.train_metrics))
+        if self.trainer is not None and \
+                not any(isinstance(h, GradientUpdateHandler)
+                        for h in handlers):
+            handlers.append(GradientUpdateHandler())
+        # reference: stable sort, most-negative priority first, so metric
+        # updates (-1000) precede logging and the gradient update (-2000)
+        # precedes everything at batch_end
+        return sorted(handlers, key=self._priority)
+
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None, batch_axis=0):
         if epochs is None and batches is None:
             epochs = 1
-        handlers = list(event_handlers or [])
-        handlers.append(StoppingHandler(epochs, batches))
-        handlers.append(MetricHandler(self.train_metrics))
-        if not any(isinstance(h, LoggingHandler) for h in handlers):
-            handlers.append(LoggingHandler(metrics=self.train_metrics))
+        self.max_epoch = epochs
+        self.max_batch = batches
+        handlers = self._assemble_handlers(event_handlers, val_data, epochs,
+                                           batches)
 
         def dispatch(kind, **kwargs):
             stop = False
@@ -60,25 +113,30 @@ class Estimator:
         while not stop:
             dispatch("epoch_begin")
             for batch in train_data:
-                dispatch("batch_begin")
+                dispatch("batch_begin", batch=batch)
                 data, label, pred, loss = self.fit_batch(batch, batch_axis)
-                if self.trainer is not None:
-                    self.trainer.step(data.shape[batch_axis])
-                self.train_loss_metric.update(0, loss)
-                if dispatch("batch_end", pred=pred, label=label, loss=loss):
+                if dispatch("batch_end", batch=batch, pred=pred, label=label,
+                            loss=loss, data=data,
+                            batch_size=data.shape[batch_axis]):
                     stop = True
                     break
             if dispatch("epoch_end") or stop:
                 stop = True
         dispatch("train_end")
 
+    def evaluate_batch(self, batch, batch_axis=0):
+        data, label = self._batch_fn(batch)
+        pred = self.net(data)
+        loss = self.loss(pred, label)
+        return data, label, pred, loss
+
     def evaluate(self, val_data, val_metrics=None, batch_axis=0):
-        metrics = val_metrics or self.train_metrics
-        for m in metrics:
+        metrics = _check_metrics(val_metrics) or self.val_metrics
+        for m in metrics + [self.val_loss_metric]:
             m.reset()
         for batch in val_data:
-            data, label = self._batch_fn(batch)
-            pred = self.net(data)
+            _, label, pred, loss = self.evaluate_batch(batch, batch_axis)
+            self.val_loss_metric.update(0, loss)
             for m in metrics:
                 m.update(label, pred)
         return metrics

@@ -12,7 +12,7 @@ import numpy as onp
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
            "BatchEnd", "StoppingHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler"]
+           "EarlyStoppingHandler", "GradientUpdateHandler"]
 
 
 class TrainBegin:
@@ -254,3 +254,20 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
         if self.stopped_epoch > 0:
             logging.getLogger("mxnet_tpu.estimator").info(
                 "Early stopping at epoch %d", self.stopped_epoch)
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Runs trainer.step at batch_end with the highest priority, so user
+    handlers observing gradients run before the update (reference:
+    event_handler.py GradientUpdateHandler)."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if estimator.trainer is not None:
+            bs = kwargs.get("batch_size")
+            if bs is None:
+                loss = kwargs.get("loss")
+                bs = loss.shape[0] if getattr(loss, "ndim", 0) else 1
+            estimator.trainer.step(bs)

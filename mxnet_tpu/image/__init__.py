@@ -21,7 +21,20 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "ResizeAug", "ForceResizeAug", "RandomCropAug", "CenterCropAug",
            "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
            "ContrastJitterAug", "SaturationJitterAug", "CreateAugmenter",
-           "ImageIter"]
+           "ImageIter",
+           "HueJitterAug",
+           "RandomOrderAug",
+           "ColorJitterAug",
+           "LightingAug",
+           "RandomGrayAug",
+           "RandomSizedCropAug",
+           "DetAugmenter",
+           "DetBorrowAug",
+           "DetHorizontalFlipAug",
+           "DetRandomCropAug",
+           "DetRandomPadAug",
+           "DetRandomSelectAug",
+           "CreateDetAugmenter"]
 
 
 def _np(img):
@@ -201,6 +214,311 @@ class SaturationJitterAug(_JitterAug):
         return NDArray(img * a + gray * (1 - a))
 
 
+class HueJitterAug(_JitterAug):
+    """Hue rotation in YIQ space (reference: image.py HueJitterAug)."""
+
+    def __call__(self, src):
+        img = _np(src).astype("float32")
+        alpha = _random.host_rng.uniform(-self.jitter, self.jitter)
+        u, w = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
+        t_yiq = onp.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]])
+        t_rgb = onp.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]])
+        rot = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
+        t = t_rgb @ rot @ t_yiq
+        return NDArray(img @ t.T.astype("float32"))
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order (reference: RandomOrderAug —
+    the color-jitter pipeline shuffles per sample)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = _random.host_rng.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Random-order brightness/contrast/saturation jitter (reference:
+    image.py ColorJitterAug over RandomOrderAug)."""
+    ts = []
+    if brightness > 0:
+        ts.append(BrightnessJitterAug(brightness))
+    if contrast > 0:
+        ts.append(ContrastJitterAug(contrast))
+    if saturation > 0:
+        ts.append(SaturationJitterAug(saturation))
+    return RandomOrderAug(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based RGB lighting noise (reference: LightingAug; AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__()
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, dtype="float32")
+        self.eigvec = onp.asarray(eigvec, dtype="float32")
+
+    def __call__(self, src):
+        alpha = _random.host_rng.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return NDArray(_np(src).astype("float32") + rgb.astype("float32"))
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to 3-channel grayscale (reference: RandomGrayAug)."""
+
+    _W = onp.array([0.299, 0.587, 0.114], dtype="float32")
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _random.host_rng.rand() < self.p:
+            img = _np(src).astype("float32")
+            gray = (img * self._W).sum(axis=-1, keepdims=True)
+            return NDArray(onp.broadcast_to(gray, img.shape).copy())
+        return src
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random area+aspect crop then resize (reference: RandomSizedCropAug /
+    inception-style)."""
+
+    def __init__(self, size, area=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interp=2):
+        super().__init__()
+        self.size = size
+        self.area = area if isinstance(area, tuple) else (area, 1.0)
+        self.ratio = ratio
+
+    def __call__(self, src):
+        img = _np(src)
+        h, w = img.shape[:2]
+        src_area = h * w
+        for _ in range(10):
+            target = _random.host_rng.uniform(*self.area) * src_area
+            ar = _random.host_rng.uniform(*self.ratio)
+            nw = int(round((target * ar) ** 0.5))
+            nh = int(round((target / ar) ** 0.5))
+            if nw <= w and nh <= h:
+                x0 = _random.host_rng.randint(0, w - nw + 1)
+                y0 = _random.host_rng.randint(0, h - nh + 1)
+                crop = img[y0:y0 + nh, x0:x0 + nw]
+                return imresize(NDArray(crop.copy()), self.size[0],
+                                self.size[1])
+        return center_crop(src, self.size)[0]
+
+
+# -- detection augmenters (reference: image/detection.py det_aug family) ----
+class DetAugmenter:
+    """Augmenter over (image, label) pairs; label rows [cls, x1, y1, x2, y2]
+    in RELATIVE coords (reference: image/detection.py DetAugmenter)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only augmenter into the detection pipeline."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _random.host_rng.rand() < self.p:
+            img = NDArray(_np(src)[:, ::-1].copy())
+            lab = onp.array(label, dtype="float32", copy=True)
+            x1 = lab[:, 1].copy()
+            lab[:, 1] = 1.0 - lab[:, 3]
+            lab[:, 3] = 1.0 - x1
+            return img, lab
+        return src, onp.asarray(label, dtype="float32")
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (SSD-style; reference:
+    DetRandomCropAug). Boxes are clipped to the crop; boxes whose center
+    falls outside are dropped (marked -1)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.3, 1.0), max_attempts=20):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        img = _np(src)
+        h, w = img.shape[:2]
+        lab = onp.array(label, dtype="float32", copy=True)
+        for _ in range(self.max_attempts):
+            area = _random.host_rng.uniform(*self.area_range)
+            ar = _random.host_rng.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, (area * ar) ** 0.5)
+            ch = min(1.0, (area / ar) ** 0.5)
+            cx = _random.host_rng.uniform(0, 1 - cw)
+            cy = _random.host_rng.uniform(0, 1 - ch)
+            valid = lab[:, 0] >= 0
+            if valid.any():
+                centers_x = (lab[valid, 1] + lab[valid, 3]) / 2
+                centers_y = (lab[valid, 2] + lab[valid, 4]) / 2
+                inside = ((centers_x >= cx) & (centers_x <= cx + cw) &
+                          (centers_y >= cy) & (centers_y <= cy + ch))
+                if not inside.any():
+                    continue
+                # coverage constraint (reference: min_object_covered):
+                # every kept (center-inside) box must have enough of its
+                # area inside the crop
+                ix1 = onp.maximum(lab[valid, 1], cx)
+                iy1 = onp.maximum(lab[valid, 2], cy)
+                ix2 = onp.minimum(lab[valid, 3], cx + cw)
+                iy2 = onp.minimum(lab[valid, 4], cy + ch)
+                inter = onp.clip(ix2 - ix1, 0, None) * \
+                    onp.clip(iy2 - iy1, 0, None)
+                area = (lab[valid, 3] - lab[valid, 1]) * \
+                    (lab[valid, 4] - lab[valid, 2])
+                cov = onp.where(area > 0, inter / onp.maximum(area, 1e-12),
+                                0.0)
+                if (cov[inside] < self.min_object_covered).any():
+                    continue
+            x0, y0 = int(cx * w), int(cy * h)
+            x1, y1 = int((cx + cw) * w), int((cy + ch) * h)
+            crop = img[y0:y1, x0:x1]
+            new = lab.copy()
+            for i in range(new.shape[0]):
+                if new[i, 0] < 0:
+                    continue
+                bcx = (new[i, 1] + new[i, 3]) / 2
+                bcy = (new[i, 2] + new[i, 4]) / 2
+                if not (cx <= bcx <= cx + cw and cy <= bcy <= cy + ch):
+                    new[i] = -1.0
+                    continue
+                new[i, 1] = onp.clip((new[i, 1] - cx) / cw, 0, 1)
+                new[i, 3] = onp.clip((new[i, 3] - cx) / cw, 0, 1)
+                new[i, 2] = onp.clip((new[i, 2] - cy) / ch, 0, 1)
+                new[i, 4] = onp.clip((new[i, 4] - cy) / ch, 0, 1)
+            return NDArray(crop.copy()), new
+        return src, lab
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expand-and-pad (zoom out; reference: DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=20,
+                 pad_val=(127, 127, 127)):
+        self.area_range = area_range
+        self.aspect_ratio_range = aspect_ratio_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        img = _np(src)
+        h, w = img.shape[:2]
+        lab = onp.array(label, dtype="float32", copy=True)
+        nw = nh = 0
+        for _ in range(self.max_attempts):
+            scale = _random.host_rng.uniform(*self.area_range)
+            ar = _random.host_rng.uniform(*self.aspect_ratio_range)
+            nw = int(w * (scale * ar) ** 0.5)
+            nh = int(h * (scale / ar) ** 0.5)
+            if nw >= w and nh >= h:
+                break
+        if nw < w or nh < h:
+            return src, lab
+        x0 = _random.host_rng.randint(0, nw - w + 1)
+        y0 = _random.host_rng.randint(0, nh - h + 1)
+        canvas = onp.empty((nh, nw) + img.shape[2:], img.dtype)
+        canvas[...] = onp.asarray(self.pad_val, dtype=img.dtype)
+        canvas[y0:y0 + h, x0:x0 + w] = img
+        valid = lab[:, 0] >= 0
+        lab[valid, 1] = (lab[valid, 1] * w + x0) / nw
+        lab[valid, 3] = (lab[valid, 3] * w + x0) / nw
+        lab[valid, 2] = (lab[valid, 2] * h + y0) / nh
+        lab[valid, 4] = (lab[valid, 4] * h + y0) / nh
+        return NDArray(canvas), lab
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one of several det augmenters (or skip)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if _random.host_rng.rand() < self.skip_prob or not self.aug_list:
+            return src, onp.asarray(label, dtype="float32")
+        pick = _random.host_rng.randint(len(self.aug_list))
+        return self.aug_list[pick](src, label)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None, brightness=0,
+                       contrast=0, saturation=0, pca_noise=0, hue=0,
+                       inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.3, 3.0), pad_val=(127, 127, 127)):
+    """Build the detection augmenter list (reference: image/detection.py
+    CreateDetAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])))
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(1.0, area_range[0]), area_range[1]),
+                              pad_val=pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.814],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    auglist.append(DetBorrowAug(ForceResizeAug((data_shape[2],
+                                                data_shape[1]))))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(ColorJitterAug(brightness, contrast,
+                                                   saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(
+            mean, std if std is not None else onp.ones(3))))
+    return auglist
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, pca_noise=0, rand_gray=0,
@@ -223,6 +541,14 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         auglist.append(ContrastJitterAug(contrast))
     if saturation:
         auglist.append(SaturationJitterAug(saturation))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.814],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = onp.array([123.68, 116.28, 103.53])
     if std is True:

@@ -127,9 +127,24 @@ class Optimizer:
     update_multi_precision = update
 
     def _update_one(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         t = self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        if isinstance(grad, RowSparseNDArray):
+            # row-sparse gradient (embeddings): optimizers with a true lazy
+            # path update only the active rows (reference: sparse
+            # FComputeEx kernels, optimizer_op.cc); others fall back to the
+            # dense math via densification — same numbers, no laziness
+            target = state.get("weight_fp32", weight)
+            if self._apply_sparse(target, grad, state, _f32(lr),
+                                  _f32(wd), t):
+                if target is not weight:  # multi-precision: master updated,
+                    weight._set_data(     # round down to the live weight
+                        target._data.astype(weight.dtype))
+                return
+            grad = grad.todense()
         if self.rescale_grad != 1.0:
             # rescale OUTSIDE the jitted step: Trainer mutates rescale_grad
             # per call (trainer.py step), so it must not be baked into the
@@ -147,6 +162,11 @@ class Optimizer:
 
     def _apply(self, weight, grad, state, lr, wd, t):
         raise NotImplementedError
+
+    def _apply_sparse(self, weight, grad, state, lr, wd, t):
+        """Lazy row-sparse update; return True when handled. Base: not
+        handled (caller densifies)."""
+        return False
 
     # common grad preprocessing, traced into each jitted step (rescale is
     # handled eagerly in _update_one; only the static clip bound bakes in)
@@ -178,10 +198,13 @@ _rescale_jit = jax.jit(lambda g, r: g * r)
 class SGD(Optimizer):
     """SGD with momentum/nesterov (reference: optimizer_op.cc sgd_mom_update)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
                  **kwargs):
         super().__init__(learning_rate, **kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update  # reference default: sparse grads
+        # update only their active rows; lazy_update=False forces the dense
+        # semantics (weight decay reaches every row)
 
         def step(w, mom, g, lr, wd):
             g = self._pre(g).astype(jnp.float32)
@@ -216,6 +239,18 @@ class SGD(Optimizer):
                                       lr, wd)
             w._set_data(new_w)
             state["mom"]._set_data(new_m)
+
+    def _apply_sparse(self, weight, grad, state, lr, wd, t):
+        if self.momentum != 0.0 or not self.lazy_update:
+            return False  # dense semantics requested (or dense momentum)
+        from ..ops.registry import get_op
+
+        fn = get_op("sparse_sgd_update").fn(
+            lr=float(lr), wd=float(wd), rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient or -1.0)
+        weight._set_data(fn(weight._data, grad.data._data,
+                            grad.indices._data))
+        return True
 
 
 @register
@@ -394,6 +429,7 @@ class RMSProp(Optimizer):
 class AdaGrad(Optimizer):
     def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
         super().__init__(learning_rate, **kwargs)
+        self._eps = epsilon
 
         def step(w, h, g, lr, wd):
             g = self._pre(g) + wd * w
@@ -409,6 +445,19 @@ class AdaGrad(Optimizer):
         new_w, h = self._step(w._data, state["history"]._data, g._data, lr, wd)
         w._set_data(new_w)
         state["history"]._set_data(h)
+
+    def _apply_sparse(self, weight, grad, state, lr, wd, t):
+        from ..ops.registry import get_op
+
+        fn = get_op("sparse_adagrad_update").fn(
+            lr=float(lr), epsilon=self._eps, wd=float(wd),
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient or -1.0)
+        new_w, new_h = fn(weight._data, state["history"]._data,
+                          grad.data._data, grad.indices._data)
+        weight._set_data(new_w)
+        state["history"]._set_data(new_h)
+        return True
 
 
 @register

@@ -6,6 +6,8 @@ import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.test_utils import assert_almost_equal
 from mxnet_tpu import io as mio
 from mxnet_tpu import recordio
 from mxnet_tpu.ndarray.ndarray import NDArray
@@ -182,3 +184,70 @@ def test_batchify_with_dataloader():
     batches = list(loader)
     assert batches[0][0].shape == (4, 4)
     assert batches[1][0].shape == (4, 8)
+
+
+def test_color_jitter_random_order_and_new_augs():
+    """ColorJitterAug shuffles child order per sample; PCA lighting, gray,
+    hue, random-sized crop all run (reference: image.py aug family)."""
+    from mxnet_tpu import image
+
+    img = np.array(onp.random.uniform(0, 255, (32, 32, 3)).astype("uint8"))
+    jit = image.ColorJitterAug(0.3, 0.3, 0.3)
+    assert isinstance(jit, image.RandomOrderAug) and len(jit.ts) == 3
+    out = jit(img)
+    assert out.shape == (32, 32, 3)
+    assert image.HueJitterAug(0.2)(img).shape == (32, 32, 3)
+    assert image.RandomGrayAug(1.0)(img).shape == (32, 32, 3)
+    g = image.RandomGrayAug(1.0)(img).asnumpy()
+    assert_almost_equal(g[..., 0], g[..., 1], rtol=1e-5)  # truly gray
+    eigval = onp.array([55.46, 4.794, 1.148])
+    eigvec = onp.eye(3)
+    assert image.LightingAug(0.1, eigval, eigvec)(img).shape == (32, 32, 3)
+    rc = image.RandomSizedCropAug((16, 16))(img)
+    assert rc.shape[0] == 16 and rc.shape[1] == 16
+    augs = image.CreateAugmenter((3, 24, 24), rand_crop=True,
+                                 rand_mirror=True, brightness=0.1,
+                                 pca_noise=0.05, rand_gray=0.2, mean=True,
+                                 std=True)
+    x = img
+    for a in augs:
+        x = a(x)
+    assert x.shape[-1] == 3
+
+
+def test_det_augmenter_pipeline():
+    """Detection augmenters keep (image, label) consistent (reference:
+    image/detection.py)."""
+    from mxnet_tpu import image
+
+    img = np.array(onp.random.uniform(0, 255, (40, 60, 3)).astype("uint8"))
+    label = onp.array([[0, 0.1, 0.2, 0.5, 0.7],
+                       [2, 0.6, 0.1, 0.9, 0.4]], "float32")
+
+    # flip: x-coords mirror, classes unchanged
+    im2, lab2 = image.DetHorizontalFlipAug(p=1.0)(img, label)
+    assert_almost_equal(lab2[:, 1], 1.0 - label[:, 3], rtol=1e-6)
+    assert (lab2[:, 0] == label[:, 0]).all()
+
+    # pad: boxes shrink into the canvas, stay within [0, 1]
+    im3, lab3 = image.DetRandomPadAug(area_range=(1.5, 2.0))(img, label)
+    assert im3.shape[0] >= img.shape[0]
+    assert (lab3[:, 1:] >= 0).all() and (lab3[:, 1:] <= 1).all()
+
+    # crop: labels stay relative; dropped boxes are -1
+    im4, lab4 = image.DetRandomCropAug()(img, label)
+    valid = lab4[:, 0] >= 0
+    if valid.any():
+        assert (lab4[valid, 1:] >= 0).all() and (lab4[valid, 1:] <= 1).all()
+
+    # full pipeline produces the target shape
+    augs = image.CreateDetAugmenter((3, 24, 24), rand_crop=0.5,
+                                    rand_pad=0.5, rand_mirror=True,
+                                    brightness=0.1, contrast=0.1,
+                                    saturation=0.1, hue=0.1, mean=True,
+                                    std=True)
+    im5, lab5 = img, label
+    for a in augs:
+        im5, lab5 = a(im5, lab5)
+    assert im5.shape[:2] == (24, 24)
+    assert lab5.shape[1] == 5

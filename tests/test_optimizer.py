@@ -159,3 +159,73 @@ def test_fused_multi_update_matches_per_param():
         wa = net_a.collect_params()["0.weight"].data().asnumpy()
         wb = net_b.collect_params()["0.weight"].data().asnumpy()
         assert onp.abs(wa - wb).max() < 1e-6, name
+
+
+def test_sparse_grad_lazy_update_sgd_and_adagrad():
+    """Row-sparse gradients take the lazy path: untouched rows bit-equal
+    (reference: sparse FComputeEx sgd/adagrad, optimizer_op.cc)."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    for opt in (optimizer.SGD(learning_rate=0.1),
+                optimizer.AdaGrad(learning_rate=0.1)):
+        w = np.array(onp.random.randn(8, 4).astype("float32"))
+        before = w.asnumpy().copy()
+        state = opt.create_state(0, w)
+        g = RowSparseNDArray(onp.random.randn(2, 4).astype("float32"),
+                             [2, 5], (8, 4))
+        opt.update(0, w, g, state)
+        after = w.asnumpy()
+        untouched = [0, 1, 3, 4, 6, 7]
+        assert (after[untouched] == before[untouched]).all(), type(opt)
+        assert not (after[[2, 5]] == before[[2, 5]]).all(), type(opt)
+
+
+def test_sparse_grad_densifies_for_momentum():
+    """Optimizers without a lazy path densify — same numbers as dense."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    w1 = np.array(onp.ones((4, 3), "float32"))
+    w2 = np.array(onp.ones((4, 3), "float32"))
+    s1 = opt.create_state(0, w1)
+    s2 = opt.create_state(1, w2)
+    gd = onp.zeros((4, 3), "float32")
+    gd[1] = 0.5
+    g_sparse = RowSparseNDArray(gd[[1]], [1], (4, 3))
+    opt.update(0, w1, g_sparse, s1)
+    opt.update(1, w2, np.array(gd), s2)
+    assert_almost_equal(w1.asnumpy(), w2.asnumpy(), rtol=1e-6)
+
+
+def test_sparse_grad_multi_precision_master_stays_current():
+    """Sparse updates must go through the fp32 master when multi-precision
+    is on, or a later dense update would revert them."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    opt = optimizer.SGD(learning_rate=0.1, multi_precision=True)
+    w = np.array(onp.ones((4, 3)).astype("float32")).astype("bfloat16")
+    state = opt.create_state_multi_precision(0, w)
+    assert "weight_fp32" in state
+    g = RowSparseNDArray(onp.ones((1, 3), "float32"), [2], (4, 3))
+    opt.update_multi_precision(0, w, g, state)
+    master = state["weight_fp32"].asnumpy()
+    assert master[2, 0] != 1.0           # master saw the sparse step
+    assert float(w.asnumpy()[2, 0].astype("float32")) != 1.0
+    # a following dense update must NOT revert the sparse rows
+    gd = np.zeros((4, 3))
+    opt.update_multi_precision(0, w, gd, state)
+    assert state["weight_fp32"].asnumpy()[2, 0] != 1.0
+
+
+def test_sparse_grad_lazy_update_false_densifies():
+    """lazy_update=False: weight decay reaches every row (dense semantics)."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    opt = optimizer.SGD(learning_rate=0.1, wd=0.5, lazy_update=False)
+    w = np.array(onp.ones((4, 3), "float32"))
+    state = opt.create_state(0, w)
+    g = RowSparseNDArray(onp.zeros((1, 3), "float32"), [1], (4, 3))
+    opt.update(0, w, g, state)
+    after = w.asnumpy()
+    # all rows decayed, including inactive ones
+    assert (after < 1.0).all(), after
