@@ -71,6 +71,10 @@ from . import amp
 
 kv = kvstore
 
+# late-registered ops (e.g. contrib.quantization's quantize/dequantize) get
+# their reference-name aliases now that every subpackage has imported
+ops.aliases._register_all()
+
 
 def waitall():
     engine.wait_all()

@@ -90,29 +90,33 @@ _NUM_BINS = 2048
 _NUM_QUANT = 128  # int8 positive levels
 
 
-def _kl_threshold(hist, hist_max):
+def _kl_threshold(hist, hist_max, num_quant=_NUM_QUANT):
     """KL-divergence-optimal |x| clipping threshold for int8.
 
     The reference algorithm (calibrate.cc LayerHistogramCollector →
     GetOptimalThreshold): for each candidate threshold, compare the clipped
-    reference distribution P against its 128-level quantization Q and pick
-    the threshold minimizing KL(P||Q).
+    reference distribution P against its ``num_quant``-level quantization Q
+    and pick the threshold minimizing KL(P||Q). Works for any histogram
+    size; candidate thresholds step through the bins of the given histogram.
     """
-    hist = hist.astype(onp.float64)
+    hist = onp.asarray(hist).astype(onp.float64)
+    num_bins = hist.shape[0]
     if hist.sum() == 0 or hist_max == 0:
         return 1.0
+    num_quant = min(num_quant, num_bins)
+    step = max(1, (num_bins - num_quant) // 120)  # ~120 candidates
     best_kl, best_t = onp.inf, hist_max
-    for i in range(_NUM_QUANT, _NUM_BINS + 1, 16):
+    for i in range(num_quant, num_bins + 1, step):
         p = hist[:i].copy()
         p[i - 1] += hist[i:].sum()  # clip outliers into the last bin
         if p.sum() == 0:
             continue
-        # quantize the i bins down to _NUM_QUANT levels
-        factor = i / _NUM_QUANT
+        # quantize the i bins down to num_quant levels
+        factor = i / num_quant
         q = onp.zeros(i)
-        for j in range(_NUM_QUANT):
+        for j in range(num_quant):
             lo, hi = int(round(j * factor)), int(round((j + 1) * factor))
-            hi = max(hi, lo + 1)
+            hi = min(max(hi, lo + 1), i)
             chunk = hist[lo:hi]
             nz = (chunk > 0).sum()
             if nz:
@@ -127,7 +131,7 @@ def _kl_threshold(hist, hist_max):
             mask, pn * onp.log(onp.maximum(pn, 1e-12) /
                                onp.maximum(qn, 1e-12)), 0.0)))
         if kl < best_kl:
-            best_kl, best_t = kl, (i / _NUM_BINS) * hist_max
+            best_kl, best_t = kl, (i / num_bins) * hist_max
     return best_t
 
 

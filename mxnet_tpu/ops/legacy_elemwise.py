@@ -1,0 +1,657 @@
+"""Legacy scalar / strict-elemwise / creation / slice op families.
+
+TPU-native registrations for the reference op names that carry a *distinct
+signature* from the numpy-surface ops (so a plain alias would be wrong):
+
+- ``_*_scalar`` binary-with-scalar family — the scalar operand is a static
+  attr (reference: src/operator/tensor/elemwise_binary_scalar_op_basic.cc).
+  Keeping it static is TPU-friendly: under CachedOp tracing the constant is
+  baked into the jitted HLO instead of becoming a device operand.
+- creation ops (reference: src/operator/tensor/init_op.cc, numpy/np_init_op.cc)
+- legacy slice family (reference: src/operator/tensor/matrix_op.cc)
+- legacy ``Reshape`` 0/-1/-2/-3/-4 shape codes and ``_npx_reshape``
+  (reference: matrix_op-inl.h InferReshapeShape, np_matrix_op.cc NumpyXReshape)
+- LARS / multi-tensor helper ops (reference: src/operator/contrib/multi_lars.cc,
+  multi_sum_sq.cc, reset_arrays.cc)
+- small contrib ops: div_sqrt_dim, index_array, gradientmultiplier, LRN,
+  SoftmaxActivation, BatchNormWithReLU, SyncBatchNorm, make_loss.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import register, register_alias, get_op
+
+# ---------------------------------------------------------------------------
+# binary-with-scalar family — elemwise_binary_scalar_op_basic.cc:*
+# ---------------------------------------------------------------------------
+_SCALAR_OPS = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+    "_npi_copysign_scalar": lambda x, s: jnp.copysign(x, s),
+    "_npi_rcopysign_scalar": lambda x, s: jnp.copysign(
+        jnp.asarray(s, x.dtype), x),
+    "_npi_arctan2_scalar": lambda x, s: jnp.arctan2(
+        x, jnp.asarray(s, x.dtype)),
+    "_npi_rarctan2_scalar": lambda x, s: jnp.arctan2(
+        jnp.asarray(s, x.dtype), x),
+    "_npi_fmax_scalar": lambda x, s: jnp.fmax(x, s),
+    "_npi_fmin_scalar": lambda x, s: jnp.fmin(x, s),
+    "_npi_fmod_scalar": lambda x, s: jnp.fmod(x, s),
+    "_npi_rfmod_scalar": lambda x, s: jnp.fmod(jnp.asarray(s, x.dtype), x),
+    "_npi_ldexp_scalar": lambda x, s: jnp.ldexp(x, jnp.int32(s)),
+    "_npi_rldexp_scalar": lambda x, s: jnp.ldexp(
+        jnp.asarray(s, jnp.float32), x.astype(jnp.int32)),
+}
+for _name, _fn2 in _SCALAR_OPS.items():
+    register(_name,
+             (lambda f: (lambda scalar=0.0, is_int=False, **a:
+                         (lambda x: f(x, scalar))))(_fn2))
+
+_SCALAR_INT_OPS = {
+    "_npi_gcd_scalar": lambda x, s: jnp.gcd(x, jnp.asarray(s, x.dtype)),
+    "_npi_lcm_scalar": lambda x, s: jnp.lcm(x, jnp.asarray(s, x.dtype)),
+    "_npi_bitwise_and_scalar": lambda x, s: jnp.bitwise_and(
+        x, jnp.asarray(s, x.dtype)),
+    "_npi_bitwise_or_scalar": lambda x, s: jnp.bitwise_or(
+        x, jnp.asarray(s, x.dtype)),
+    "_npi_bitwise_xor_scalar": lambda x, s: jnp.bitwise_xor(
+        x, jnp.asarray(s, x.dtype)),
+}
+for _name, _fn2 in _SCALAR_INT_OPS.items():
+    register(_name,
+             (lambda f: (lambda scalar=0, is_int=True, **a:
+                         (lambda x: f(x, int(scalar)))))(_fn2),
+             differentiable=False)
+
+
+# legacy comparison-with-scalar: reference returns input dtype 0/1, not bool
+# (elemwise_binary_scalar_op_logic.cc) and registers zero-gradient.
+_SCALAR_CMP = {
+    "_equal_scalar": jnp.equal,
+    "_not_equal_scalar": jnp.not_equal,
+    "_greater_scalar": jnp.greater,
+    "_greater_equal_scalar": jnp.greater_equal,
+    "_lesser_scalar": jnp.less,
+    "_lesser_equal_scalar": jnp.less_equal,
+    "_logical_and_scalar": jnp.logical_and,
+    "_logical_or_scalar": jnp.logical_or,
+    "_logical_xor_scalar": jnp.logical_xor,
+}
+for _name, _fn2 in _SCALAR_CMP.items():
+    register(_name,
+             (lambda f: (lambda scalar=0.0, is_int=False, **a:
+                         (lambda x: f(x, scalar).astype(x.dtype))))(_fn2),
+             differentiable=False)
+
+# numpy-internal dispatch names for the same scalar kernels
+for _alias, _tgt in {
+    "_npi_add_scalar": "_plus_scalar",
+    "_npi_subtract_scalar": "_minus_scalar",
+    "_npi_rsubtract_scalar": "_rminus_scalar",
+    "_npi_multiply_scalar": "_mul_scalar",
+    "_npi_true_divide_scalar": "_div_scalar",
+    "_npi_rtrue_divide_scalar": "_rdiv_scalar",
+    "_npi_mod_scalar": "_mod_scalar",
+    "_npi_rmod_scalar": "_rmod_scalar",
+    "_npi_power_scalar": "_power_scalar",
+    "_npi_rpower_scalar": "_rpower_scalar",
+}.items():
+    register_alias(_alias, _tgt)
+
+# where-with-scalar variants (np_where_op.cc)
+register("_npi_where_lscalar", lambda scalar=0.0, **a:
+         (lambda cond, rhs: jnp.where(cond.astype(bool), scalar, rhs)))
+register("_npi_where_rscalar", lambda scalar=0.0, **a:
+         (lambda cond, lhs: jnp.where(cond.astype(bool), lhs, scalar)))
+register("_npi_where_scalar2", lambda x=0.0, y=0.0, **a:
+         (lambda cond: jnp.where(cond.astype(bool),
+                                 jnp.float32(x), jnp.float32(y))),
+         differentiable=False)
+
+# ---------------------------------------------------------------------------
+# missing unary ops — elemwise_unary_op_basic.cc / _pow.cc
+# ---------------------------------------------------------------------------
+register("reciprocal_sqrt", lambda **a: lax.rsqrt)          # rsqrt
+register("rcbrt", lambda **a: (lambda x: 1.0 / jnp.cbrt(x)))
+register("digamma", lambda **a: jax.scipy.special.digamma)
+register("hard_sigmoid", lambda alpha=0.2, beta=0.5:
+         (lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0)))
+register("nanprod", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.nanprod(x, axis=axis, keepdims=keepdims)))
+register("ones_like", lambda **a: jnp.ones_like)
+register("zeros_like", lambda **a: jnp.zeros_like)
+register_alias("_npi_ones_like", "ones_like")
+register_alias("_npi_zeros_like", "zeros_like")
+
+
+def _make_make_loss(grad_scale=1.0, **a):
+    """MakeLoss (src/operator/make_loss.cc): identity forward; the backward
+    seeds the tape with grad_scale regardless of the incoming gradient."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None),
+             lambda res, g: (jnp.full_like(g, grad_scale),))
+    return f
+
+
+register("make_loss", _make_make_loss)
+register_alias("MakeLoss", "make_loss")
+
+
+def _make_gradmult(scalar=1.0, **a):
+    """gradientmultiplier (contrib/gradient_multiplier_op.cc): identity
+    forward, gradient scaled by ``scalar`` (gradient-reversal when < 0)."""
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda res, g: (g * scalar,))
+    return f
+
+
+register("gradientmultiplier", _make_gradmult)
+register_alias("_contrib_gradientmultiplier", "gradientmultiplier")
+
+
+def _make_id_kl(sparseness_target=0.1, penalty=0.001, momentum=0.9, **a):
+    """IdentityAttachKLSparseReg (src/operator/identity_attach_KL_sparse_reg.cc):
+    identity forward; backward adds the KL-divergence sparsity penalty gradient
+    penalty * (-t/rho + (1-t)/(1-rho)) where rho is the batch mean activation.
+    """
+    t = sparseness_target
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def fwd(x):
+        return x, jnp.clip(jnp.mean(x), 1e-6, 1 - 1e-6)
+
+    def bwd(rho, g):
+        return (g + penalty * (-t / rho + (1 - t) / (1 - rho)),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+register("IdentityAttachKLSparseReg", _make_id_kl)
+
+register("_grad_add", lambda **a: jnp.add)
+register("add_n", lambda num_args=0, **a:
+         (lambda *xs: sum(xs[1:], xs[0])))
+register_alias("ElementWiseSum", "add_n")
+register("_identity_with_attr_like_rhs", lambda **a:
+         (lambda lhs, rhs: lhs), differentiable=False)
+register("_npx_constraint_check", lambda msg="constraint violated", **a:
+         (lambda x: _constraint_check(x, msg)), differentiable=False)
+
+
+def _constraint_check(x, msg):
+    ok = jnp.all(x)
+    # eager path surfaces the failure immediately; under jit the boolean
+    # result flows to the caller (reference npx.constraint_check contract)
+    try:
+        if not bool(ok):
+            raise MXNetError(msg)
+    except jax.errors.TracerBoolConversionError:
+        pass
+    return ok
+
+
+register("div_sqrt_dim", lambda **a:
+         (lambda x: x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype))))
+register_alias("_contrib_div_sqrt_dim", "div_sqrt_dim")
+
+# ---------------------------------------------------------------------------
+# creation ops — init_op.cc + numpy/np_init_op.cc (zero-input ops)
+# ---------------------------------------------------------------------------
+register("zeros", lambda shape=(), dtype="float32", ctx=None, **a:
+         (lambda: jnp.zeros(shape, dtype or "float32")),
+         differentiable=False)
+register("ones", lambda shape=(), dtype="float32", ctx=None, **a:
+         (lambda: jnp.ones(shape, dtype or "float32")),
+         differentiable=False)
+register("full", lambda shape=(), value=0.0, dtype="float32", ctx=None, **a:
+         (lambda: jnp.full(shape, value, dtype or "float32")),
+         differentiable=False)
+register("full_like", lambda fill_value=0.0, dtype=None, **a:
+         (lambda x: jnp.full_like(x, fill_value, dtype=dtype)),
+         differentiable=False)
+register("eye", lambda N=1, M=None, k=0, dtype="float32", ctx=None, **a:
+         (lambda: jnp.eye(int(N), M if M is None else int(M), k=int(k),
+                          dtype=dtype or "float32")),
+         differentiable=False)
+register("identity", lambda n=1, dtype="float32", ctx=None, **a:
+         (lambda: jnp.identity(int(n), dtype=dtype or "float32")),
+         differentiable=False)
+def _make_arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32",
+                 ctx=None, infer_range=False, **a):
+    # legacy contract (init_op.cc RangeParam): arange(N) means [0, N)
+    lo, hi = (0, start) if stop is None else (start, stop)
+
+    def f():
+        out = jnp.arange(lo, hi, step, dtype=dtype)
+        return jnp.repeat(out, repeat) if repeat != 1 else out
+
+    return f
+
+
+register("arange", _make_arange, differentiable=False)
+register("linspace", lambda start=0.0, stop=1.0, num=50, endpoint=True,
+         dtype="float32", ctx=None, **a:
+         (lambda: jnp.linspace(start, stop, int(num), endpoint=endpoint,
+                               dtype=dtype)),
+         differentiable=False)
+register("logspace", lambda start=0.0, stop=1.0, num=50, endpoint=True,
+         base=10.0, dtype="float32", ctx=None, **a:
+         (lambda: jnp.logspace(start, stop, int(num), endpoint=endpoint,
+                               base=base, dtype=dtype)),
+         differentiable=False)
+register("tri", lambda N=1, M=None, k=0, dtype="float32", ctx=None, **a:
+         (lambda: jnp.tri(int(N), M if M is None else int(M), int(k),
+                          dtype=dtype)),
+         differentiable=False)
+register("indices", lambda dimensions=(), dtype="int32", ctx=None, **a:
+         (lambda: jnp.indices(tuple(dimensions), dtype=dtype)),
+         differentiable=False)
+for _alias, _tgt in {
+    "_zeros": "zeros", "_zeros_without_dtype": "zeros", "_ones": "ones",
+    "_full": "full", "_eye": "eye", "_arange": "arange",
+    "_linspace": "linspace",
+    "_npi_zeros": "zeros", "_npi_ones": "ones", "_npi_full": "full",
+    "_npi_full_like": "full_like", "_npi_eye": "eye",
+    "_npi_identity": "identity", "_npi_arange": "arange",
+    "_npi_linspace": "linspace", "_npi_logspace": "logspace",
+    "_npi_tri": "tri", "_npi_indices": "indices",
+}.items():
+    register_alias(_alias, _tgt)
+
+# ---------------------------------------------------------------------------
+# stack/split variants — np_matrix_op.cc
+# ---------------------------------------------------------------------------
+register("hstack", lambda **a: (lambda *xs: jnp.hstack(xs)))
+register("vstack", lambda **a: (lambda *xs: jnp.vstack(xs)))
+register("dstack", lambda **a: (lambda *xs: jnp.dstack(xs)))
+register("column_stack", lambda **a: (lambda *xs: jnp.column_stack(xs)))
+register("hsplit", lambda indices_or_sections=1, **a:
+         (lambda x: tuple(jnp.hsplit(x, indices_or_sections))))
+register("dsplit", lambda indices_or_sections=1, **a:
+         (lambda x: tuple(jnp.dsplit(x, indices_or_sections))))
+for _alias, _tgt in {
+    "_npi_hstack": "hstack", "_npi_vstack": "vstack",
+    "_npi_dstack": "dstack", "_npi_column_stack": "column_stack",
+    "_npi_hsplit": "hsplit", "_npi_dsplit": "dsplit",
+}.items():
+    register_alias(_alias, _tgt)
+
+# ---------------------------------------------------------------------------
+# legacy slice family — matrix_op.cc (slice:700, slice_axis:780, slice_like)
+# ---------------------------------------------------------------------------
+def _norm_be(b, e, s, dim):
+    """Normalize one (begin, end, step) triple to a Python slice."""
+    s = 1 if s in (None, 0) else s
+    if b is not None and b < 0:
+        b += dim
+    if e is not None and e < 0:
+        e += dim
+    return slice(b, e, s)
+
+
+def _legacy_slice_key(begin, end, step, shape):
+    step = tuple(step or ()) + (None,) * (len(begin) - len(step or ()))
+    return tuple(_norm_be(b, e, s, d)
+                 for b, e, s, d in zip(begin, end, step, shape))
+
+
+register("slice", lambda begin=(), end=(), step=(), **a:
+         (lambda x: x[_legacy_slice_key(begin, end, step, x.shape)]))
+register_alias("crop", "slice")
+register("slice_axis", lambda axis=0, begin=0, end=None, **a:
+         (lambda x: lax.slice_in_dim(
+             x, begin if begin >= 0 else x.shape[axis] + begin,
+             x.shape[axis] if end is None
+             else (end if end >= 0 else x.shape[axis] + end),
+             axis=axis)))
+register("slice_like", lambda axes=(), **a:
+         (lambda x, like: x[tuple(
+             slice(0, like.shape[i]) if (not axes or i in tuple(
+                 ax + x.ndim if ax < 0 else ax for ax in axes)) else
+             slice(None) for i in range(x.ndim))]))
+register("broadcast_axis", lambda axis=(), size=(), **a:
+         (lambda x: _broadcast_axis(x, axis, size)))
+register_alias("broadcast_axes", "broadcast_axis")
+register("broadcast_like", lambda lhs_axes=None, rhs_axes=None, **a:
+         (lambda x, like: jnp.broadcast_to(x, like.shape)
+          if lhs_axes is None else _broadcast_like_axes(
+              x, like, lhs_axes, rhs_axes)))
+register("reshape_like", lambda lhs_begin=None, lhs_end=None,
+         rhs_begin=None, rhs_end=None, **a:
+         (lambda x, like: _reshape_like(x, like, lhs_begin, lhs_end,
+                                        rhs_begin, rhs_end)))
+
+
+def _broadcast_axis(x, axis, size):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(x.shape)
+    for ax, sz in zip(axis, size):
+        shape[ax] = sz
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def _broadcast_like_axes(x, like, lhs_axes, rhs_axes):
+    shape = list(x.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        shape[la] = like.shape[ra]
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def _reshape_like(x, like, lb, le, rb, re):
+    if lb is None and le is None and rb is None and re is None:
+        return jnp.reshape(x, like.shape)
+    lb = 0 if lb is None else lb
+    le = x.ndim if le is None else le
+    rb = 0 if rb is None else rb
+    re = like.ndim if re is None else re
+    new_shape = x.shape[:lb] + like.shape[rb:re] + x.shape[le:]
+    return jnp.reshape(x, new_shape)
+
+
+# legacy Reshape with 0/-1/-2/-3/-4 codes — matrix_op-inl.h InferReshapeShape
+def _legacy_reshape_shape(src, spec, reverse=False):
+    if reverse:
+        src = src[::-1]
+        spec = tuple(spec)[::-1]
+    out, i = [], 0
+    spec = list(spec)
+    j = 0
+    while j < len(spec):
+        c = spec[j]
+        if c == 0:
+            out.append(src[i]); i += 1
+        elif c == -1:
+            out.append(-1); i += 1
+        elif c == -2:
+            out.extend(src[i:]); i = len(src)
+        elif c == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif c == -4:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(c); i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+register("Reshape", lambda shape=(), reverse=False, **a:
+         (lambda x: jnp.reshape(
+             x, _legacy_reshape_shape(x.shape, shape, reverse))))
+
+
+def _npx_reshape_shape(src, spec):
+    """NumpyXReshape (np_matrix_op.cc): -2 copy dim, -3 skip (merge into
+    neighbor? no: -3 means merge two consecutive), -4 split with trailing
+    dims, -5 merge two consecutive into one, -6 split into two."""
+    out, i = [], 0
+    spec = list(spec)
+    j = 0
+    while j < len(spec):
+        c = spec[j]
+        if c == -2:
+            out.append(src[i]); i += 1
+        elif c == -1:
+            out.append(-1); i += 1
+        elif c == -3:
+            out.extend(src[i:]); i = len(src)
+        elif c == -5:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif c == -6:
+            d1, d2 = spec[j + 1], spec[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        elif c == 0:
+            out.append(0); i += 1
+        else:
+            out.append(c); i += 1
+        j += 1
+    return tuple(out)
+
+
+register("_npx_reshape", lambda newshape=(), reverse=False, **a:
+         (lambda x: jnp.reshape(x, _npx_reshape_shape(x.shape, newshape))))
+
+register("SliceChannel", lambda num_outputs=1, axis=1, squeeze_axis=False, **a:
+         (lambda x: tuple(
+             jnp.squeeze(p, axis) if squeeze_axis else p
+             for p in jnp.split(x, num_outputs, axis))),
+         nout=2)
+register_alias("split_legacy", "SliceChannel")
+register("_split_v2", lambda indices=(), axis=0, squeeze_axis=False,
+         sections=0, **a:
+         (lambda x: tuple(
+             jnp.squeeze(p, axis) if squeeze_axis else p
+             for p in (jnp.split(x, sections, axis) if sections
+                       else jnp.split(x, list(indices), axis)))),
+         nout=2)
+register("swapaxes_legacy", lambda dim1=0, dim2=0, **a:
+         (lambda x: jnp.swapaxes(x, dim1, dim2)))
+register("_rnn_param_concat", lambda dim=0, num_args=0, **a:
+         (lambda *xs: jnp.concatenate([jnp.ravel(x) for x in xs], 0)))
+
+# ---------------------------------------------------------------------------
+# scatter / assignment — indexing_op.cc, matrix_op.cc (_slice_assign:410)
+# ---------------------------------------------------------------------------
+register("scatter_nd", lambda shape=(), **a:
+         (lambda data, ind: jnp.zeros(shape, data.dtype).at[
+             tuple(ind[i] for i in range(ind.shape[0]))].add(data)))
+register("_scatter_set_nd", lambda shape=(), **a:
+         (lambda data, ind: jnp.zeros(shape, data.dtype).at[
+             tuple(ind[i] for i in range(ind.shape[0]))].set(data)))
+register("_slice_assign", lambda begin=(), end=(), step=(), **a:
+         (lambda lhs, rhs: lhs.at[
+             _legacy_slice_key(begin, end, step, lhs.shape)].set(rhs)))
+register_alias("_crop_assign", "_slice_assign")
+register("_slice_assign_scalar", lambda begin=(), end=(), step=(),
+         scalar=0.0, **a:
+         (lambda lhs: lhs.at[
+             _legacy_slice_key(begin, end, step, lhs.shape)].set(scalar)))
+register_alias("_crop_assign_scalar", "_slice_assign_scalar")
+
+# ---------------------------------------------------------------------------
+# sparse-storage helpers — cast_storage.cc, square_sum.cc, sparse_retain.cc.
+# Dense jax arrays are the single storage here (PJRT HBM); RowSparse/CSR
+# live in mxnet_tpu.ndarray.sparse as wrappers, so cast_storage on the op
+# level is identity over values (the NDArray frontend swaps the wrapper).
+# ---------------------------------------------------------------------------
+register("cast_storage", lambda stype="default", **a: (lambda x: x))
+register("_sparse_retain", lambda **a:
+         (lambda data, idx: jnp.zeros_like(data).at[idx].set(data[idx])))
+register("square_sum", lambda axis=None, keepdims=False, **a:
+         (lambda x: jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims)))
+register_alias("_square_sum", "square_sum")
+
+# ---------------------------------------------------------------------------
+# multi-tensor helpers — multi_sum_sq.cc, multi_lars.cc, reset_arrays.cc
+# ---------------------------------------------------------------------------
+register("multi_sum_sq", lambda num_arrays=1, **a:
+         (lambda *xs: tuple(jnp.sum(jnp.square(x)) for x in xs)),
+         nout=2, differentiable=False)
+register("reset_arrays", lambda num_arrays=1, **a:
+         (lambda *xs: tuple(jnp.zeros_like(x) for x in xs)),
+         nout=2, differentiable=False)
+
+
+def _multi_lars(eta=0.001, eps=1e-8, rescale_grad=1.0, **a):
+    """multi_lars (contrib/multi_lars.cc): layer-wise adaptive LR —
+    lr * eta * ||w|| / (||g|| * rescale + wd * ||w|| + eps), with the plain
+    lr kept where either norm is zero."""
+
+    def f(lrs, w_sq, g_sq, wds):
+        w_n = jnp.sqrt(w_sq)
+        g_n = jnp.sqrt(g_sq) * rescale_grad
+        adaptive = eta * w_n / (g_n + wds * w_n + eps)
+        cond = (w_n > 0) & (g_n > 0)
+        return lrs * jnp.where(cond, adaptive, 1.0)
+
+    return f
+
+
+register("multi_lars", _multi_lars, differentiable=False)
+
+# ---------------------------------------------------------------------------
+# histogram — tensor/histogram.cc (static bin_cnt attr, or bin-edges input)
+# ---------------------------------------------------------------------------
+register("histogram", lambda bin_cnt=None, range=None, **a:
+         ((lambda x: tuple(jnp.histogram(x, bins=bin_cnt,
+                                         range=tuple(range)
+                                         if range else None)))
+          if bin_cnt is not None else
+          (lambda x, bins: tuple(jnp.histogram(x, bins=bins)))),
+         nout=2, differentiable=False)
+register_alias("_histogram", "histogram")
+
+# ---------------------------------------------------------------------------
+# contrib: index_array (contrib/index_array.cc), share_memory,
+# diag_indices_from (np_matrix_op.cc)
+# ---------------------------------------------------------------------------
+register("index_array", lambda axes=None, **a:
+         (lambda x: _index_array(x, axes)), differentiable=False)
+register_alias("_contrib_index_array", "index_array")
+
+
+def _index_array(x, axes):
+    grids = jnp.indices(x.shape, dtype=jnp.int32)
+    full = jnp.stack([g for g in grids], axis=-1)
+    if axes is not None:
+        full = full[..., tuple(axes)]
+    return full
+
+
+register("_npi_share_memory", lambda **a:
+         (lambda a_, b: jnp.array(False)), differentiable=False)
+register("_npi_diag_indices_from", lambda **a:
+         (lambda x: tuple(jnp.arange(x.shape[0])
+                          for _ in range(x.ndim))),
+         nout=2, differentiable=False)
+
+# ---------------------------------------------------------------------------
+# legacy NN extras: LRN (nn/lrn.cc), SoftmaxActivation
+# (nn/softmax_activation.cc), BatchNormWithReLU / SyncBatchNorm
+# (contrib/batch_norm_relu.cc, contrib/sync_batch_norm.cc)
+# ---------------------------------------------------------------------------
+def _lrn(alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **a):
+    def f(x):
+        sq = jnp.square(x)
+        half = nsize // 2
+        # cross-channel window sum on axis 1 (NCHW): static unrolled sum of
+        # shifted slices — fully differentiable and fuses into one HLO
+        pads = [(0, 0)] * x.ndim
+        pads[1] = (half, half)
+        padded = jnp.pad(sq, pads)
+        c = x.shape[1]
+        ssum = sum(lax.slice_in_dim(padded, k, k + c, axis=1)
+                   for k in range(nsize))
+        return x / jnp.power(knorm + (alpha / nsize) * ssum, beta)
+
+    return f
+
+
+register("lrn", _lrn)
+register_alias("LRN", "lrn")
+
+
+def _softmax_activation(mode="instance", **a):
+    def f(x):
+        if mode == "channel":
+            return jax.nn.softmax(x, axis=1)
+        flat = x.reshape(x.shape[0], -1)
+        return jax.nn.softmax(flat, axis=-1).reshape(x.shape)
+
+    return f
+
+
+register("softmax_activation", _softmax_activation)
+register_alias("SoftmaxActivation", "softmax_activation")
+
+
+def _bn_with_relu(**attrs):
+    bn = get_op("batch_norm")._make_fn(**attrs)
+
+    def f(x, gamma, beta, mmean, mvar):
+        out = bn(x, gamma, beta, mmean, mvar)
+        y, *rest = out if isinstance(out, tuple) else (out,)
+        return (jax.nn.relu(y), *rest)
+
+    return f
+
+
+register("batch_norm_with_relu", _bn_with_relu, nout=3)
+register_alias("_contrib_BatchNormWithReLU", "batch_norm_with_relu")
+
+
+def _sync_batch_norm(eps=1e-3, momentum=0.9, fix_gamma=True, ndev=1,
+                     key="", axis_name=None, **a):
+    """SyncBatchNorm: under pjit/shard_map the plain batch_norm already
+    computes *global* batch statistics (XLA inserts the all-reduce for the
+    mean/var reductions over the sharded batch axis); inside an explicit
+    shard_map region pass ``axis_name`` to psum the per-device moments
+    (reference semantics: contrib/sync_batch_norm.cc ndev all-reduce)."""
+
+    def f(x, gamma, beta, mmean, mvar):
+        g = jnp.ones_like(gamma) if fix_gamma else gamma
+        red = tuple(i for i in range(x.ndim) if i != 1)
+        mean = jnp.mean(x, axis=red)
+        mean_sq = jnp.mean(jnp.square(x), axis=red)
+        if axis_name is not None:
+            mean = lax.pmean(mean, axis_name)
+            mean_sq = lax.pmean(mean_sq, axis_name)
+        var = mean_sq - jnp.square(mean)
+        shape = [1] * x.ndim
+        shape[1] = x.shape[1]
+        out = (x - mean.reshape(shape)) * lax.rsqrt(
+            var.reshape(shape) + eps) * g.reshape(shape) + beta.reshape(shape)
+        new_mean = lax.stop_gradient(momentum * mmean + (1 - momentum) * mean)
+        new_var = lax.stop_gradient(momentum * mvar + (1 - momentum) * var)
+        return out, new_mean, new_var
+
+    return f
+
+
+register("sync_batch_norm", _sync_batch_norm, nout=3)
+register_alias("_contrib_SyncBatchNorm", "sync_batch_norm")
+
+# dynamic_reshape (contrib/dynamic_shape_ops.cc): shape arrives as a tensor —
+# eager-only by design (data-dependent output shape cannot trace under jit;
+# same restriction as the reference's dynamic-shape ops under hybridize).
+register("_contrib_dynamic_reshape", lambda **a:
+         (lambda x, shape: jnp.reshape(x, tuple(int(s) for s in shape))),
+         jit=False)

@@ -1,0 +1,409 @@
+"""Dynamic-shape numpy manipulation ops, control-flow ops, and the last
+contrib stragglers.
+
+- ``_npi_unique``/``_npx_nonzero``/``_npi_delete``/``_npi_insert_*``/
+  ``_contrib_boolean_mask``/``_npi_advanced_indexing*``
+  (src/operator/numpy/np_unique_op.cc, np_nonzero_op.cc, np_delete_op.cc,
+  np_insert_op*.cc, contrib/boolean_mask.cc): data-dependent output shapes.
+  The reference pins them to CPU FComputeEx; here they are eager host ops
+  (``jit=False``) — under CachedOp tracing they raise, same restriction the
+  reference has under hybridize.
+- ``_foreach``/``_while_loop``/``_cond`` (src/operator/control_flow.cc:1096,
+  1157,1218): higher-order ops. The TPU-native lowering is lax.scan /
+  lax.while_loop / lax.cond via numpy_extension.control_flow — registered
+  here as ops whose subgraph attr is the Python callable (the reference
+  stores the subgraph as a node attr the same way).
+- hawkesll, mrcnn_mask_target, RROIAlign, calibrate_entropy
+  (contrib/hawkes_ll.cc, mrcnn_mask_target.cu, deformable ROI family,
+  quantization/calibrate.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register, register_alias
+
+# ---------------------------------------------------------------------------
+# dynamic-shape manip (eager host ops)
+# ---------------------------------------------------------------------------
+@register("unique", nout=2, jit=False, differentiable=False)
+def _unique(return_index=False, return_inverse=False, return_counts=False,
+            axis=None, **a):
+    def f(x):
+        res = onp.unique(onp.asarray(x), return_index=return_index,
+                         return_inverse=return_inverse,
+                         return_counts=return_counts, axis=axis)
+        if isinstance(res, tuple):
+            return tuple(jnp.asarray(r) for r in res)
+        return jnp.asarray(res)
+
+    return f
+
+
+register_alias("_npi_unique", "unique")
+
+
+@register("nonzero", jit=False, differentiable=False)
+def _nonzero(**a):
+    """npx.nonzero (np_nonzero_op.cc): returns an (N, ndim) int array of
+    indices — transposed relative to numpy's tuple convention."""
+    def f(x):
+        nz = onp.nonzero(onp.asarray(x))
+        return jnp.asarray(onp.stack(nz, axis=-1).astype("int32"))
+
+    return f
+
+
+register_alias("_npx_nonzero", "nonzero")
+
+
+@register("boolean_mask", jit=False, differentiable=False)
+def _boolean_mask(axis=0, **a):
+    """contrib/boolean_mask.cc: rows of ``data`` where ``mask`` is true.
+    Dynamic output shape -> eager only; the bounded-shape variant
+    (flatnonzero_bounded + take) is the jit-friendly alternative."""
+    def f(data, mask):
+        d = onp.asarray(data)
+        m = onp.asarray(mask).astype(bool)
+        return jnp.asarray(onp.compress(m, d, axis=axis))
+
+    return f
+
+
+register_alias("_contrib_boolean_mask", "boolean_mask")
+
+register("_npi_boolean_mask_assign_scalar", lambda value=0.0, **a:
+         (lambda data, mask: jnp.where(
+             mask.astype(bool).reshape(
+                 mask.shape + (1,) * (data.ndim - mask.ndim)),
+             jnp.asarray(value, data.dtype), data)))
+register("_npi_boolean_mask_assign_tensor", lambda **a:
+         (lambda data, mask, value: _mask_assign_tensor(data, mask, value)),
+         jit=False, differentiable=False)
+
+
+def _mask_assign_tensor(data, mask, value):
+    d = onp.asarray(data).copy()
+    m = onp.asarray(mask).astype(bool)
+    d[m] = onp.asarray(value)
+    return jnp.asarray(d)
+
+
+@register("delete", jit=False, differentiable=False)
+def _delete(start=None, stop=None, step=None, int_ind=None, axis=None, **a):
+    def f(x, *obj):
+        arr = onp.asarray(x)
+        if obj:
+            sel = onp.asarray(obj[0]).astype("int64")
+        elif int_ind is not None:
+            sel = int_ind
+        else:
+            sel = slice(start, stop, step)
+        return jnp.asarray(onp.delete(arr, sel, axis=axis))
+
+    return f
+
+
+register_alias("_npi_delete", "delete")
+
+
+def _insert_impl(arr, index, values, axis):
+    return jnp.asarray(onp.insert(onp.asarray(arr), index,
+                                  onp.asarray(values), axis=axis))
+
+
+@register("_npi_insert_scalar", jit=False, differentiable=False)
+def _insert_scalar(int_ind=0, val=None, axis=None, **a):
+    def f(x, *values):
+        vals = values[0] if values else val
+        return _insert_impl(x, int_ind, vals, axis)
+
+    return f
+
+
+@register("_npi_insert_slice", jit=False, differentiable=False)
+def _insert_slice(start=None, stop=None, step=None, val=None, axis=None,
+                  **a):
+    def f(x, *values):
+        vals = values[0] if values else val
+        return _insert_impl(x, slice(start, stop, step), vals, axis)
+
+    return f
+
+
+@register("_npi_insert_tensor", jit=False, differentiable=False)
+def _insert_tensor(axis=None, **a):
+    def f(x, values, index):
+        return _insert_impl(x, onp.asarray(index).astype("int64"),
+                            values, axis)
+
+    return f
+
+
+@register("advanced_indexing", jit=False, differentiable=False)
+def _advanced_indexing(**a):
+    """_npi_advanced_indexing (np_indexing_op.cc): x[idx] with an integer
+    or boolean index array."""
+    def f(x, idx):
+        i = onp.asarray(idx)
+        if i.dtype == bool:
+            return jnp.asarray(onp.asarray(x)[i])
+        return jnp.asarray(onp.asarray(x)[i.astype("int64")])
+
+    return f
+
+
+register_alias("_npi_advanced_indexing", "advanced_indexing")
+
+
+@register("advanced_indexing_multiple", jit=False, differentiable=False)
+def _advanced_indexing_multiple(**a):
+    """x[idx0, idx1, ...] with broadcast integer index arrays."""
+    def f(x, *idxs):
+        key = tuple(onp.asarray(i).astype("int64") for i in idxs)
+        return jnp.asarray(onp.asarray(x)[key])
+
+    return f
+
+
+register_alias("_npi_advanced_indexing_multiple",
+               "advanced_indexing_multiple")
+
+# eig/eigvals dispatch names (linalg_legacy implements the kernels)
+register_alias("_npi_eig", "linalg_eig")
+register_alias("_npi_eigvals", "linalg_eigvals")
+
+# ---------------------------------------------------------------------------
+# legacy Concat (dim attr + variadic args) — src/operator/nn/concat.cc
+# ---------------------------------------------------------------------------
+register("Concat", lambda dim=1, num_args=0, **a:
+         (lambda *xs: jnp.concatenate(xs, axis=dim)))
+register_alias("concat", "Concat")
+
+# ---------------------------------------------------------------------------
+# control flow — control_flow.cc (_foreach:1096, _while_loop:1157, _cond:1218)
+# ---------------------------------------------------------------------------
+@register("_foreach", nout=2, jit=False)
+def _foreach_op(body=None, num_states=0, **a):
+    """Runs ``body(slice, states)`` over axis 0 — lowered to lax.scan by
+    npx.foreach (the TPU-correct loop: one trace, no per-step dispatch)."""
+    def f(data, *states):
+        from ..numpy_extension import control_flow as cf
+        from ..ndarray.ndarray import NDArray
+
+        outs, st = cf.foreach(body, NDArray(data),
+                              [NDArray(s) for s in states])
+        out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        st_list = st if isinstance(st, (list, tuple)) else [st]
+        return tuple(o._data for o in out_list) + \
+            tuple(s._data for s in st_list)
+
+    return f
+
+
+@register("_while_loop", nout=2, jit=False)
+def _while_loop_op(cond=None, func=None, max_iterations=None, **a):
+    def f(*loop_vars):
+        from ..numpy_extension import control_flow as cf
+        from ..ndarray.ndarray import NDArray
+
+        outs, final = cf.while_loop(cond, func,
+                                    [NDArray(v) for v in loop_vars],
+                                    max_iterations=max_iterations)
+        out_list = outs if isinstance(outs, (list, tuple)) else [outs]
+        fin_list = final if isinstance(final, (list, tuple)) else [final]
+        return tuple(o._data for o in out_list) + \
+            tuple(s._data for s in fin_list)
+
+    return f
+
+
+@register("_cond", jit=False)
+def _cond_op(then_func=None, else_func=None, **a):
+    def f(pred, *inputs):
+        from ..numpy_extension import control_flow as cf
+        from ..ndarray.ndarray import NDArray
+
+        out = cf.cond(NDArray(pred), then_func, else_func,
+                      [NDArray(v) for v in inputs])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return f
+
+
+# ---------------------------------------------------------------------------
+# contrib stragglers
+# ---------------------------------------------------------------------------
+@register("hawkesll", nout=2)
+def _hawkesll(**a):
+    """Log-likelihood of a marked self-exciting Hawkes process
+    (contrib/hawkes_ll.cc). Inputs follow the reference:
+    mu (K,), alpha (K,), beta (K,), state (N,K), lags (N,T), marks (N,T),
+    valid_length (N,), max_time (N,). Returns (loglik (N,), new_state)."""
+    def f(mu, alpha, beta, state, lags, marks, valid_length, max_time):
+        N, T = lags.shape
+        K = mu.shape[0]
+        marks_i = marks.astype(jnp.int32)
+        t_idx = jnp.arange(T)
+        valid = t_idx[None, :] < valid_length[:, None].astype(jnp.int32)
+
+        def step(carry, xs):
+            rmem, t_elapsed, comp = carry
+            lag, mark, ok = xs
+            decay = jnp.exp(-beta[None, :] * lag[:, None])
+            rmem_d = rmem * decay
+            lam = mu[mark] + alpha[mark] * jnp.take_along_axis(
+                rmem_d, mark[:, None], axis=1)[:, 0]
+            ll = jnp.where(ok, jnp.log(jnp.maximum(lam, 1e-30)), 0.0)
+            one_hot = jax.nn.one_hot(mark, K, dtype=rmem.dtype)
+            rmem_new = jnp.where(ok[:, None], rmem_d + one_hot, rmem)
+            t_new = jnp.where(ok, t_elapsed + lag, t_elapsed)
+            # this event's excitation integral over (t_event, max_time]:
+            # alpha_m/beta_m * (1 - e^{-beta_m (T - t_event)})
+            contrib = (alpha[mark] / beta[mark]) * \
+                (1.0 - jnp.exp(-beta[mark] *
+                               jnp.maximum(max_time - t_new, 0.0)))
+            comp_new = comp + jnp.where(ok, contrib, 0.0)
+            return (rmem_new, t_new, comp_new), ll
+
+        (rmem_f, t_f, comp_events), lls = jax.lax.scan(
+            step, (state, jnp.zeros(N, lags.dtype),
+                   jnp.zeros(N, lags.dtype)),
+            (lags.T, marks_i.T, valid.T))
+        # compensator = baseline integral + per-event excitation integrals
+        # + the decaying contribution of the incoming pre-window state
+        comp_base = jnp.sum(mu) * max_time
+        comp_state = jnp.sum(
+            (alpha / beta)[None, :] * state *
+            (1.0 - jnp.exp(-beta[None, :] * max_time[:, None])), axis=1)
+        loglik = jnp.sum(lls, axis=0) - comp_base - comp_events \
+            - comp_state
+        return loglik, rmem_f
+
+    return f
+
+
+register_alias("_contrib_hawkesll", "hawkesll")
+
+
+@register("mrcnn_mask_target", nout=2, differentiable=False)
+def _mrcnn_mask_target(num_rois=1, mask_size=(28, 28), num_classes=1,
+                       sample_ratio=2, **a):
+    """Mask R-CNN training-target generator
+    (contrib/mrcnn_mask_target.cu): crop each gt mask under its ROI and
+    resize to mask_size; emit per-class one-hot mask weights."""
+    def f(rois, gt_masks, matches, cls_targets):
+        B = rois.shape[0]
+        Hm, Wm = mask_size
+        Hg, Wg = gt_masks.shape[-2:]
+
+        def one_roi(roi, mask):
+            x0, y0, x1, y1 = roi[0], roi[1], roi[2], roi[3]
+            ys = y0 + (jnp.arange(Hm) + 0.5) / Hm * (y1 - y0)
+            xs = x0 + (jnp.arange(Wm) + 0.5) / Wm * (x1 - x0)
+            yi = jnp.clip(ys.astype(jnp.int32), 0, Hg - 1)
+            xi = jnp.clip(xs.astype(jnp.int32), 0, Wg - 1)
+            return mask[yi[:, None], xi[None, :]]
+
+        def one_image(roi_b, masks_b, match_b):
+            sel = masks_b[match_b.astype(jnp.int32)]
+            return jax.vmap(one_roi)(roi_b, sel)
+
+        m_targets = jax.vmap(one_image)(rois, gt_masks, matches)
+        cls = cls_targets.astype(jnp.int32)
+        weights = jax.nn.one_hot(cls, num_classes,
+                                 dtype=m_targets.dtype)
+        m_out = m_targets[:, :, None, :, :] * \
+            weights[..., None, None]
+        w_out = jnp.broadcast_to(weights[..., None, None],
+                                 m_out.shape)
+        return m_out, w_out
+
+    return f
+
+
+register_alias("_contrib_mrcnn_mask_target", "mrcnn_mask_target")
+
+
+@register("rroi_align", differentiable=False)
+def _rroi_align(pooled_size=(7, 7), spatial_scale=1.0, sampling_ratio=-1,
+                **a):
+    """Rotated ROI align (contrib RROIAlign): rois are
+    (batch_idx, cx, cy, w, h, angle_degrees); bilinear sampling on a
+    rotated grid."""
+    def f(data, rois):
+        Ph, Pw = pooled_size
+        _, C, H, W = data.shape
+
+        def one(roi):
+            b = roi[0].astype(jnp.int32)
+            cx, cy, w, h = (roi[1] * spatial_scale,
+                            roi[2] * spatial_scale,
+                            roi[3] * spatial_scale,
+                            roi[4] * spatial_scale)
+            ang = roi[5] * jnp.pi / 180.0
+            ys = (jnp.arange(Ph) + 0.5) / Ph - 0.5
+            xs = (jnp.arange(Pw) + 0.5) / Pw - 0.5
+            gy, gx = jnp.meshgrid(ys * h, xs * w, indexing="ij")
+            cos, sin = jnp.cos(ang), jnp.sin(ang)
+            sx = cx + gx * cos - gy * sin
+            sy = cy + gx * sin + gy * cos
+            x0 = jnp.clip(jnp.floor(sx).astype(jnp.int32), 0, W - 1)
+            y0 = jnp.clip(jnp.floor(sy).astype(jnp.int32), 0, H - 1)
+            x1 = jnp.clip(x0 + 1, 0, W - 1)
+            y1 = jnp.clip(y0 + 1, 0, H - 1)
+            wx = jnp.clip(sx - x0, 0.0, 1.0)
+            wy = jnp.clip(sy - y0, 0.0, 1.0)
+            img = data[b]
+            v = (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                 + img[:, y0, x1] * (1 - wy) * wx
+                 + img[:, y1, x0] * wy * (1 - wx)
+                 + img[:, y1, x1] * wy * wx)
+            return v
+
+        return jax.vmap(one)(rois)
+
+    return f
+
+
+register_alias("_contrib_RROIAlign", "rroi_align")
+
+
+@register("calibrate_entropy", nout=2, jit=False, differentiable=False)
+def _calibrate_entropy(num_quantized_bins=255, **a):
+    """KL-divergence-optimal threshold from a histogram
+    (quantization/calibrate.cc): returns (min_range, max_range)."""
+    def f(hist, hist_edges):
+        from ..contrib.quantization import _kl_threshold
+
+        h = onp.asarray(hist)
+        edges = onp.asarray(hist_edges)
+        t = _kl_threshold(h, float(edges[-1]),
+                          num_quant=max(1, num_quantized_bins // 2))
+        return (jnp.asarray(onp.float32(-t)), jnp.asarray(onp.float32(t)))
+
+    return f
+
+
+register_alias("_contrib_calibrate_entropy", "calibrate_entropy")
+
+
+@register("Custom", jit=False)
+def _custom(op_type="", **a):
+    """Custom-op dispatch (src/operator/custom/custom.cc): routes to the
+    Python CustomOp registry in mxnet_tpu.operator."""
+    def f(*inputs):
+        from .. import operator as op_mod
+        from ..ndarray.ndarray import NDArray
+
+        out = op_mod.custom(*[NDArray(x) for x in inputs],
+                            op_type=op_type)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return f

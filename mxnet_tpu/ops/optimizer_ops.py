@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .registry import register
+from .registry import register, register_alias
 
 __all__ = []
 
@@ -327,3 +327,445 @@ def _multi_all_finite(num_arrays=1, init_output=True):
         return ok.reshape(())
 
     return f
+
+
+# -- mixed-precision (mp_*) single-tensor updates ---------------------------
+# Reference: optimizer_op.cc mp_sgd_update:746, mp_sgd_mom_update,
+# mp_nag_mom_update, mp_lamb_update_phase1/2 (contrib). The fp32 master copy
+# is an explicit operand; the fp16/bf16 weight output is the cast-back.
+@register("mp_sgd_update", nout=2)
+def _mp_sgd_update(lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    def f(weight, grad, weight32):
+        g = grad.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        w32 = weight32 - lr * (g + wd * weight32)
+        return w32.astype(weight.dtype), w32
+
+    return f
+
+
+@register("mp_sgd_mom_update", nout=3)
+def _mp_sgd_mom_update(lr=0.01, momentum=0.9, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    def f(weight, grad, mom, weight32):
+        g = grad.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m = momentum * mom - lr * (g + wd * weight32)
+        w32 = weight32 + m
+        return w32.astype(weight.dtype), m, w32
+
+    return f
+
+
+@register("mp_nag_mom_update", nout=3)
+def _mp_nag_mom_update(lr=0.01, momentum=0.9, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    def f(weight, grad, mom, weight32):
+        g = grad.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * weight32
+        m = momentum * mom + g
+        w32 = weight32 - lr * (g + momentum * m)
+        return w32.astype(weight.dtype), m, w32
+
+    return f
+
+
+@register("mp_lamb_update_phase1", nout=3)
+def _mp_lamb_phase1(beta1=0.9, beta2=0.999, epsilon=1e-6, t=1, wd=0.0,
+                    bias_correction=True, rescale_grad=1.0,
+                    clip_gradient=-1.0):
+    def f(weight, grad, mean, var, weight32):
+        g = grad.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m = beta1 * mean + (1 - beta1) * g
+        v = beta2 * var + (1 - beta2) * g * g
+        if bias_correction:
+            mh = m / (1 - beta1 ** t)
+            vh = v / (1 - beta2 ** t)
+        else:
+            mh, vh = m, v
+        return mh / (jnp.sqrt(vh) + epsilon) + wd * weight32, m, v
+
+    return f
+
+
+@register("mp_lamb_update_phase2", nout=2)
+def _mp_lamb_phase2(lr=0.001, lower_bound=-1.0, upper_bound=-1.0):
+    def f(weight, g_update, r1_in, r2_in, weight32):
+        r1 = jnp.squeeze(r1_in)
+        r2 = jnp.squeeze(r2_in)
+        if lower_bound > 0:
+            r1 = jnp.maximum(r1, lower_bound)
+        if upper_bound > 0:
+            r1 = jnp.minimum(r1, upper_bound)
+        ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+        w32 = weight32 - lr * ratio * g_update
+        return w32.astype(weight.dtype), w32
+
+    return f
+
+
+# -- multi-tensor (multi_*/preloaded_multi_*) updates -----------------------
+# Reference: optimizer_op.cc multi_sgd_mom_update:373-470 and
+# preloaded_multi_sgd*.cc (lrs/wds arrive as tensors so one graph serves
+# every step), contrib/{adamw,multi_lamb,multi_lans,adabelief}.cc.
+# Operand convention is the reference's interleaved layout.
+def _clip(g, c):
+    return jnp.clip(g, -c, c) if c > 0 else g
+
+
+@register("multi_sgd_mom_update")
+def _multi_sgd_mom_update(lrs=(), wds=(), momentum=0.9, rescale_grad=1.0,
+                          clip_gradient=-1.0, num_weights=1):
+    def f(*args):
+        out = []
+        for i in range(num_weights):
+            w, g, m = args[3 * i:3 * i + 3]
+            g = _clip(g * rescale_grad, clip_gradient)
+            m_new = momentum * m - lrs[i] * (g + wds[i] * w)
+            out.extend([w + m_new, m_new])
+        return tuple(out)
+
+    return f
+
+
+@register("multi_mp_sgd_update")
+def _multi_mp_sgd_update(lrs=(), wds=(), rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=1):
+    def f(*args):
+        out = []
+        for i in range(num_weights):
+            w, g, w32 = args[3 * i:3 * i + 3]
+            gg = _clip(g.astype(jnp.float32) * rescale_grad, clip_gradient)
+            w32n = w32 - lrs[i] * (gg + wds[i] * w32)
+            out.extend([w32n.astype(w.dtype), w32n])
+        return tuple(out)
+
+    return f
+
+
+@register("multi_mp_sgd_mom_update")
+def _multi_mp_sgd_mom_update(lrs=(), wds=(), momentum=0.9, rescale_grad=1.0,
+                             clip_gradient=-1.0, num_weights=1):
+    def f(*args):
+        out = []
+        for i in range(num_weights):
+            w, g, m, w32 = args[4 * i:4 * i + 4]
+            gg = _clip(g.astype(jnp.float32) * rescale_grad, clip_gradient)
+            m_new = momentum * m - lrs[i] * (gg + wds[i] * w32)
+            w32n = w32 + m_new
+            out.extend([w32n.astype(w.dtype), m_new, w32n])
+        return tuple(out)
+
+    return f
+
+
+@register("preloaded_multi_sgd_update")
+def _preloaded_multi_sgd_update(rescale_grad=1.0, clip_gradient=-1.0,
+                                num_weights=1):
+    def f(*args):
+        lrs, wds = args[-2], args[-1]
+        out = []
+        for i in range(num_weights):
+            w, g = args[2 * i:2 * i + 2]
+            gg = _clip(g * rescale_grad, clip_gradient)
+            out.append(w - lrs[i] * (gg + wds[i] * w))
+        return tuple(out)
+
+    return f
+
+
+@register("preloaded_multi_sgd_mom_update")
+def _preloaded_multi_sgd_mom_update(momentum=0.9, rescale_grad=1.0,
+                                    clip_gradient=-1.0, num_weights=1):
+    def f(*args):
+        lrs, wds = args[-2], args[-1]
+        out = []
+        for i in range(num_weights):
+            w, g, m = args[3 * i:3 * i + 3]
+            gg = _clip(g * rescale_grad, clip_gradient)
+            m_new = momentum * m - lrs[i] * (gg + wds[i] * w)
+            out.extend([w + m_new, m_new])
+        return tuple(out)
+
+    return f
+
+
+@register("preloaded_multi_mp_sgd_update")
+def _preloaded_multi_mp_sgd_update(rescale_grad=1.0, clip_gradient=-1.0,
+                                   num_weights=1):
+    def f(*args):
+        lrs, wds = args[-2], args[-1]
+        out = []
+        for i in range(num_weights):
+            w, g, w32 = args[3 * i:3 * i + 3]
+            gg = _clip(g.astype(jnp.float32) * rescale_grad, clip_gradient)
+            w32n = w32 - lrs[i] * (gg + wds[i] * w32)
+            out.extend([w32n.astype(w.dtype), w32n])
+        return tuple(out)
+
+    return f
+
+
+@register("preloaded_multi_mp_sgd_mom_update")
+def _preloaded_multi_mp_sgd_mom_update(momentum=0.9, rescale_grad=1.0,
+                                       clip_gradient=-1.0, num_weights=1):
+    def f(*args):
+        lrs, wds = args[-2], args[-1]
+        out = []
+        for i in range(num_weights):
+            w, g, m, w32 = args[4 * i:4 * i + 4]
+            gg = _clip(g.astype(jnp.float32) * rescale_grad, clip_gradient)
+            m_new = momentum * m - lrs[i] * (gg + wds[i] * w32)
+            w32n = w32 + m_new
+            out.extend([w32n.astype(w.dtype), m_new, w32n])
+        return tuple(out)
+
+    return f
+
+
+def _adamw_step(w32, g, m, v, lr, eta, wd, beta1, beta2, epsilon, clip_c):
+    g = _clip(g, clip_c)
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    upd = m_new / (jnp.sqrt(v_new) + epsilon) + wd * w32
+    return w32 - lr * eta * upd, m_new, v_new
+
+
+@register("multi_adamw_update")
+def _multi_adamw_update(lrs=(), wds=(), etas=(), beta1=0.9, beta2=0.999,
+                        epsilon=1e-8, clip_gradient=-1.0, num_weights=1):
+    """contrib/adamw.cc multi-tensor form: trailing operand is the
+    rescale_grad *tensor* (dynamic loss-scale) shared by every weight."""
+    def f(*args):
+        rescale = args[-1].astype(jnp.float32)
+        out = []
+        for i in range(num_weights):
+            w, g, m, v = args[4 * i:4 * i + 4]
+            w32n, m_new, v_new = _adamw_step(
+                w, g * rescale, m, v, lrs[i], etas[i], wds[i],
+                beta1, beta2, epsilon, clip_gradient)
+            out.extend([w32n, m_new, v_new])
+        return tuple(out)
+
+    return f
+
+
+@register("multi_mp_adamw_update")
+def _multi_mp_adamw_update(lrs=(), wds=(), etas=(), beta1=0.9, beta2=0.999,
+                           epsilon=1e-8, clip_gradient=-1.0, num_weights=1):
+    def f(*args):
+        rescale = args[-1].astype(jnp.float32)
+        out = []
+        for i in range(num_weights):
+            w, g, m, v, w32 = args[5 * i:5 * i + 5]
+            w32n, m_new, v_new = _adamw_step(
+                w32, g.astype(jnp.float32) * rescale, m, v, lrs[i],
+                etas[i], wds[i], beta1, beta2, epsilon, clip_gradient)
+            out.extend([w32n.astype(w.dtype), m_new, v_new, w32n])
+        return tuple(out)
+
+    return f
+
+
+def _lamb_step(w32, g, m, v, lr, wd, t, beta1, beta2, epsilon, clip_c,
+               bias_correction):
+    g = _clip(g, clip_c)
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    mh, vh = (m_new / (1 - beta1 ** t), v_new / (1 - beta2 ** t)) \
+        if bias_correction else (m_new, v_new)
+    upd = mh / (jnp.sqrt(vh) + epsilon) + wd * w32
+    r1 = jnp.linalg.norm(w32)
+    r2 = jnp.linalg.norm(upd)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return w32 - lr * ratio * upd, m_new, v_new
+
+
+@register("multi_lamb_update")
+def _multi_lamb_update(learning_rates=(), wds=(), step_count=(),
+                       beta1=0.9, beta2=0.999, epsilon=1e-6,
+                       rescale_grad=1.0, clip_gradient=-1.0,
+                       bias_correction=True, num_tensors=1):
+    def f(*args):
+        out = []
+        for i in range(num_tensors):
+            w, g, m, v = args[4 * i:4 * i + 4]
+            w_new, m_new, v_new = _lamb_step(
+                w, g * rescale_grad, m, v, learning_rates[i], wds[i],
+                step_count[i], beta1, beta2, epsilon, clip_gradient,
+                bias_correction)
+            out.extend([w_new, m_new, v_new])
+        return tuple(out)
+
+    return f
+
+
+@register("multi_mp_lamb_update")
+def _multi_mp_lamb_update(learning_rates=(), wds=(), step_count=(),
+                          beta1=0.9, beta2=0.999, epsilon=1e-6,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          bias_correction=True, num_tensors=1):
+    def f(*args):
+        out = []
+        for i in range(num_tensors):
+            w, g, m, v, w32 = args[5 * i:5 * i + 5]
+            w32n, m_new, v_new = _lamb_step(
+                w32, g.astype(jnp.float32) * rescale_grad, m, v,
+                learning_rates[i], wds[i], step_count[i], beta1, beta2,
+                epsilon, clip_gradient, bias_correction)
+            out.extend([w32n.astype(w.dtype), m_new, v_new, w32n])
+        return tuple(out)
+
+    return f
+
+
+def _lans_step(w32, g, m, v, lr, wd, t, beta1, beta2, epsilon, clip_c):
+    """LANS (contrib/multi_lans.cc): gradient pre-normalized per tensor,
+    then the two-part Nesterov-style update, each part with its own trust
+    ratio (Zheng et al., "Accelerated large batch optimization of BERT")."""
+    gn = jnp.linalg.norm(g)
+    g = jnp.where(gn > 0, g / gn, g)
+    g = _clip(g, clip_c)
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * g * g
+    mh = m_new / (1 - beta1 ** t)
+    vh = v_new / (1 - beta2 ** t)
+    denom = jnp.sqrt(vh) + epsilon
+    r1 = jnp.linalg.norm(w32)
+    part_m = mh / denom + wd * w32
+    part_g = g / denom + wd * w32
+    rm = jnp.linalg.norm(part_m)
+    rg = jnp.linalg.norm(part_g)
+    ratio_m = jnp.where((r1 > 0) & (rm > 0), r1 / rm, 1.0)
+    ratio_g = jnp.where((r1 > 0) & (rg > 0), r1 / rg, 1.0)
+    w_new = w32 - lr * (beta1 * ratio_m * part_m
+                        + (1 - beta1) * ratio_g * part_g)
+    return w_new, m_new, v_new
+
+
+@register("multi_lans_update")
+def _multi_lans_update(learning_rates=(), wds=(), step_count=(),
+                       beta1=0.9, beta2=0.999, epsilon=1e-6,
+                       rescale_grad=1.0, clip_gradient=-1.0, num_tensors=1):
+    def f(*args):
+        out = []
+        for i in range(num_tensors):
+            w, g, m, v = args[4 * i:4 * i + 4]
+            w_new, m_new, v_new = _lans_step(
+                w, g * rescale_grad, m, v, learning_rates[i], wds[i],
+                step_count[i], beta1, beta2, epsilon, clip_gradient)
+            out.extend([w_new, m_new, v_new])
+        return tuple(out)
+
+    return f
+
+
+@register("multi_mp_lans_update")
+def _multi_mp_lans_update(learning_rates=(), wds=(), step_count=(),
+                          beta1=0.9, beta2=0.999, epsilon=1e-6,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          num_tensors=1):
+    def f(*args):
+        out = []
+        for i in range(num_tensors):
+            w, g, m, v, w32 = args[5 * i:5 * i + 5]
+            w32n, m_new, v_new = _lans_step(
+                w32, g.astype(jnp.float32) * rescale_grad, m, v,
+                learning_rates[i], wds[i], step_count[i], beta1, beta2,
+                epsilon, clip_gradient)
+            out.extend([w32n.astype(w.dtype), m_new, v_new, w32n])
+        return tuple(out)
+
+    return f
+
+
+def _adabelief_step(w32, g, m, s, lr, eta, wd, beta1, beta2, epsilon,
+                    clip_c):
+    g = _clip(g, clip_c)
+    m_new = beta1 * m + (1 - beta1) * g
+    s_new = beta2 * s + (1 - beta2) * jnp.square(g - m_new) + epsilon
+    upd = m_new / (jnp.sqrt(s_new) + epsilon) + wd * w32
+    return w32 - lr * eta * upd, m_new, s_new
+
+
+@register("multi_adabelief_update")
+def _multi_adabelief_update(lrs=(), wds=(), etas=(), beta1=0.9, beta2=0.999,
+                            epsilon=1e-8, clip_gradient=-1.0,
+                            num_weights=1):
+    def f(*args):
+        rescale = args[-1].astype(jnp.float32)
+        out = []
+        for i in range(num_weights):
+            w, g, m, s = args[4 * i:4 * i + 4]
+            w_new, m_new, s_new = _adabelief_step(
+                w, g * rescale, m, s, lrs[i], etas[i], wds[i], beta1,
+                beta2, epsilon, clip_gradient)
+            out.extend([w_new, m_new, s_new])
+        return tuple(out)
+
+    return f
+
+
+@register("multi_mp_adabelief_update")
+def _multi_mp_adabelief_update(lrs=(), wds=(), etas=(), beta1=0.9,
+                               beta2=0.999, epsilon=1e-8,
+                               clip_gradient=-1.0, num_weights=1):
+    def f(*args):
+        rescale = args[-1].astype(jnp.float32)
+        out = []
+        for i in range(num_weights):
+            w, g, m, s, w32 = args[5 * i:5 * i + 5]
+            w32n, m_new, s_new = _adabelief_step(
+                w32, g.astype(jnp.float32) * rescale, m, s, lrs[i],
+                etas[i], wds[i], beta1, beta2, epsilon, clip_gradient)
+            out.extend([w32n.astype(w.dtype), m_new, s_new, w32n])
+        return tuple(out)
+
+    return f
+
+@register("mp_adamw_update", nout=4)
+def _mp_adamw_update(lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                     wd=0.0, eta=1.0, clip_gradient=-1.0):
+    """_mp_adamw_update (contrib/adamw.cc): single-tensor mixed-precision
+    AdamW; trailing operand is the rescale_grad tensor."""
+    def f(weight, grad, mean, var, weight32, rescale):
+        w32n, m_new, v_new = _adamw_step(
+            weight32, grad.astype(jnp.float32) * rescale.astype(jnp.float32),
+            mean, var, lr, eta, wd, beta1, beta2, epsilon, clip_gradient)
+        return w32n.astype(weight.dtype), m_new, v_new, w32n
+
+    return f
+
+
+@register("mp_adabelief_update", nout=4)
+def _mp_adabelief_update(lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                         wd=0.0, eta=1.0, clip_gradient=-1.0):
+    def f(weight, grad, mean, var, weight32, rescale):
+        w32n, m_new, s_new = _adabelief_step(
+            weight32, grad.astype(jnp.float32) * rescale.astype(jnp.float32),
+            mean, var, lr, eta, wd, beta1, beta2, epsilon, clip_gradient)
+        return w32n.astype(weight.dtype), m_new, s_new, w32n
+
+    return f
+
+
+# legacy underscore dispatch names (contrib op registrations)
+for _legacy, _tgt in {
+    "_multi_adamw_update": "multi_adamw_update",
+    "_multi_mp_adamw_update": "multi_mp_adamw_update",
+    "_multi_lamb_update": "multi_lamb_update",
+    "_multi_mp_lamb_update": "multi_mp_lamb_update",
+    "_multi_lans_update": "multi_lans_update",
+    "_multi_mp_lans_update": "multi_mp_lans_update",
+    "_multi_adabelief_update": "multi_adabelief_update",
+    "_multi_mp_adabelief_update": "multi_mp_adabelief_update",
+    "_mp_adamw_update": "mp_adamw_update",
+    "_mp_adabelief_update": "mp_adabelief_update",
+}.items():
+    register_alias(_legacy, _tgt)

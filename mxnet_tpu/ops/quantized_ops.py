@@ -1,0 +1,384 @@
+"""Quantized (int8) operator family + intgemm bridge.
+
+TPU-native equivalent of src/operator/quantization/*.cc and
+src/operator/contrib/intgemm/*.cc. Conventions kept from the reference:
+
+- a quantized tensor travels as ``(int8 data, min_range, max_range)`` — every
+  quantized op consumes the ranges as trailing float operands and emits its
+  own output ranges, exactly the dataflow quantize_graph_pass.cc wires up;
+- symmetric int8: scale = max(|min|, |max|) / 127;
+- int8 × int8 contractions accumulate in int32 via XLA's
+  ``preferred_element_type`` — on TPU this is the MXU's native int8 path
+  (the analog of the reference's cuDNN int8 / intgemm AVX kernels);
+- ``*_ste`` straight-through estimators for quantization-aware training
+  (reference: contrib/stes_op.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, register_alias
+
+_I8MAX = 127.0
+
+
+def _scale(mn, mx):
+    return jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-12) / _I8MAX
+
+
+# ---------------------------------------------------------------------------
+# quantize / requantize — quantize_v2.cc, requantize.cc
+# ---------------------------------------------------------------------------
+@register("quantize_v2", nout=3)
+def _quantize_v2(out_type="int8", min_calib_range=None,
+                 max_calib_range=None, **a):
+    def f(x):
+        if min_calib_range is not None:
+            mn = jnp.float32(min_calib_range)
+            mx = jnp.float32(max_calib_range)
+        else:
+            mn = jnp.min(x).astype(jnp.float32)
+            mx = jnp.max(x).astype(jnp.float32)
+        s = _scale(mn, mx)
+        q = jnp.clip(jnp.round(x / s), -_I8MAX, _I8MAX).astype(jnp.int8)
+        return q, mn, mx
+
+    return f
+
+
+register_alias("_contrib_quantize_v2", "quantize_v2")
+
+
+@register("requantize", nout=3)
+def _requantize(min_calib_range=None, max_calib_range=None, **a):
+    """int32 accumulator -> int8 with recalibrated range
+    (requantize.cc): input carries ranges of the int32 data."""
+    def f(q32, mn_in, mx_in):
+        s_in = jnp.maximum(jnp.maximum(jnp.abs(mn_in), jnp.abs(mx_in)),
+                           1e-12) / (2.0 ** 31 - 1)
+        real = q32.astype(jnp.float32) * s_in
+        if min_calib_range is not None:
+            mn = jnp.float32(min_calib_range)
+            mx = jnp.float32(max_calib_range)
+        else:
+            mn = jnp.min(real)
+            mx = jnp.max(real)
+        s_out = _scale(mn, mx)
+        q = jnp.clip(jnp.round(real / s_out), -_I8MAX, _I8MAX).astype(
+            jnp.int8)
+        return q, mn, mx
+
+    return f
+
+
+register_alias("_contrib_requantize", "requantize")
+
+
+# ---------------------------------------------------------------------------
+# quantized compute ops — quantized_*.cc
+# ---------------------------------------------------------------------------
+@register("quantized_act", nout=3)
+def _quantized_act(act_type="relu", **a):
+    """quantized_activation (quantized_activation.cc): only relu — it is
+    monotonic and zero-preserving, so it acts directly on int8 codes."""
+    def f(q, mn, mx):
+        if act_type != "relu":
+            raise ValueError("quantized_act supports act_type='relu' only")
+        return jnp.maximum(q, 0), jnp.maximum(mn, 0.0), jnp.maximum(mx, 0.0)
+
+    return f
+
+
+register_alias("_contrib_quantized_act", "quantized_act")
+
+
+@register("quantized_flatten", nout=3)
+def _quantized_flatten(**a):
+    def f(q, mn, mx):
+        return q.reshape(q.shape[0], -1), mn, mx
+
+    return f
+
+
+register_alias("_contrib_quantized_flatten", "quantized_flatten")
+
+
+@register("quantized_concat", nout=3)
+def _quantized_concat(dim=1, num_args=1, **a):
+    """quantized_concat.cc: rescale every input onto the widest range, then
+    concatenate in int8."""
+    def f(*args):
+        n = len(args) // 3
+        qs, mns, mxs = args[:n], args[n:2 * n], args[2 * n:]
+        mn = mns[0]
+        mx = mxs[0]
+        for m in mns[1:]:
+            mn = jnp.minimum(mn, m)
+        for m in mxs[1:]:
+            mx = jnp.maximum(mx, m)
+        s_out = _scale(mn, mx)
+        parts = []
+        for q, m0, m1 in zip(qs, mns, mxs):
+            s_in = _scale(m0, m1)
+            parts.append(jnp.clip(
+                jnp.round(q.astype(jnp.float32) * (s_in / s_out)),
+                -_I8MAX, _I8MAX).astype(jnp.int8))
+        return jnp.concatenate(parts, axis=dim), mn, mx
+
+    return f
+
+
+register_alias("_contrib_quantized_concat", "quantized_concat")
+
+
+@register("quantized_elemwise_add", nout=3)
+def _quantized_elemwise_add(**a):
+    def f(qa, qb, mna, mxa, mnb, mxb):
+        sa, sb = _scale(mna, mxa), _scale(mnb, mxb)
+        acc = qa.astype(jnp.int32) * jnp.round(sa * 2 ** 16).astype(
+            jnp.int32) + qb.astype(jnp.int32) * jnp.round(
+            sb * 2 ** 16).astype(jnp.int32)
+        # report the exact representable range of the int32 accumulator
+        s_out = 1.0 / 2 ** 16
+        mx = jnp.float32(2 ** 31 - 1) * s_out
+        return acc, -mx, mx
+
+    return f
+
+
+register_alias("_contrib_quantized_elemwise_add", "quantized_elemwise_add")
+
+
+@register("quantized_elemwise_mul", nout=3)
+def _quantized_elemwise_mul(**a):
+    def f(qa, qb, mna, mxa, mnb, mxb):
+        sa, sb = _scale(mna, mxa), _scale(mnb, mxb)
+        acc = qa.astype(jnp.int32) * qb.astype(jnp.int32)
+        # int32-code convention shared by every quantized producer: code
+        # 2^31-1 maps to the range max, so requantize decodes uniformly
+        s_out = sa * sb
+        mx = jnp.float32(2 ** 31 - 1) * s_out
+        return acc, -mx, mx
+
+    return f
+
+
+register_alias("_contrib_quantized_elemwise_mul", "quantized_elemwise_mul")
+
+
+@register("quantized_embedding", nout=3)
+def _quantized_embedding(input_dim=0, output_dim=0, **a):
+    def f(idx, qweight, mn, mx):
+        return (jnp.take(qweight, idx.astype(jnp.int32), axis=0), mn, mx)
+
+    return f
+
+
+register_alias("_contrib_quantized_embedding", "quantized_embedding")
+
+
+@register("quantized_fully_connected_v2", nout=3)
+def _quantized_fc(num_hidden=0, no_bias=False, flatten=True, **a):
+    """quantized_fully_connected.cc on the MXU: int8×int8→int32 GEMM via
+    preferred_element_type (XLA emits the native int8 systolic matmul)."""
+    def f(*args):
+        if no_bias:
+            qx, qw, mnx, mxx, mnw, mxw = args
+            qb = None
+        else:
+            qx, qw, qb, mnx, mxx, mnw, mxw, mnb, mxb = args
+        x = qx.reshape(qx.shape[0], -1) if flatten else qx
+        acc = lax.dot_general(
+            x.astype(jnp.int8), qw.astype(jnp.int8),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        sx, sw = _scale(mnx, mxx), _scale(mnw, mxw)
+        s_out = sx * sw
+        if qb is not None:
+            sb = _scale(mnb, mxb)
+            acc = acc + jnp.round(
+                qb.astype(jnp.float32) * (sb / s_out)).astype(jnp.int32)
+        mx = jnp.float32(2 ** 31 - 1) * s_out
+        return acc, -mx, mx
+
+    return f
+
+
+@register("quantized_conv", nout=3)
+def _quantized_conv(kernel=(), stride=(), pad=(), dilate=(), num_filter=0,
+                    no_bias=True, layout="NCHW", **a):
+    def f(*args):
+        if no_bias:
+            qx, qw, mnx, mxx, mnw, mxw = args
+            qb = None
+        else:
+            qx, qw, qb, mnx, mxx, mnw, mxw, mnb, mxb = args
+        nd = len(kernel) if kernel else qw.ndim - 2
+        strides = tuple(stride) if stride else (1,) * nd
+        pads = tuple((p, p) for p in pad) if pad else ((0, 0),) * nd
+        dil = tuple(dilate) if dilate else (1,) * nd
+        acc = lax.conv_general_dilated(
+            qx.astype(jnp.int8), qw.astype(jnp.int8), strides, pads,
+            rhs_dilation=dil, preferred_element_type=jnp.int32)
+        sx, sw = _scale(mnx, mxx), _scale(mnw, mxw)
+        s_out = sx * sw
+        if qb is not None:
+            sb = _scale(mnb, mxb)
+            acc = acc + jnp.round(qb.astype(jnp.float32) * (sb / s_out)
+                                  ).astype(jnp.int32).reshape(
+                                      1, -1, *([1] * (acc.ndim - 2)))
+        mx = jnp.float32(2 ** 31 - 1) * s_out
+        return acc, -mx, mx
+
+    return f
+
+
+register_alias("_contrib_quantized_conv", "quantized_conv")
+
+
+@register("quantized_pooling", nout=3)
+def _quantized_pooling(kernel=(), pool_type="max", stride=(), pad=(),
+                       global_pool=False, **a):
+    def f(q, mn, mx):
+        nd = len(kernel) if kernel else q.ndim - 2
+        if global_pool:
+            window = (1, 1) + q.shape[2:]
+            strides = (1,) * q.ndim
+            pads = ((0, 0),) * q.ndim
+        else:
+            window = (1, 1) + tuple(kernel)
+            strides = (1, 1) + (tuple(stride) if stride else (1,) * nd)
+            pads = ((0, 0), (0, 0)) + tuple((p, p) for p in (
+                pad if pad else (0,) * nd))
+        if pool_type == "max":
+            out = lax.reduce_window(q, jnp.array(-128, q.dtype), lax.max,
+                                    window, strides, pads)
+            return out, mn, mx
+        acc = lax.reduce_window(q.astype(jnp.int32), jnp.array(0, jnp.int32),
+                                lax.add, window, strides, pads)
+        denom = 1
+        for w in window:
+            denom *= w
+        out = jnp.round(acc.astype(jnp.float32) / denom).astype(jnp.int8)
+        return out, mn, mx
+
+    return f
+
+
+register_alias("_contrib_quantized_pooling", "quantized_pooling")
+
+
+@register("quantized_batch_norm", nout=3)
+def _quantized_batch_norm(eps=1e-3, min_calib_range=None,
+                          max_calib_range=None, **a):
+    """quantized_batch_norm.cc: BN folded onto the int8 codes — an affine
+    per-channel rescale computed from the float BN parameters."""
+    def f(q, gamma, beta, mean, var, mn, mx):
+        s_in = _scale(mn, mx)
+        inv = gamma / jnp.sqrt(var + eps)
+        shape = (1, -1) + (1,) * (q.ndim - 2)
+        real = (q.astype(jnp.float32) * s_in - mean.reshape(shape)) \
+            * inv.reshape(shape) + beta.reshape(shape)
+        if min_calib_range is not None:
+            mn_o = jnp.float32(min_calib_range)
+            mx_o = jnp.float32(max_calib_range)
+        else:
+            mn_o, mx_o = jnp.min(real), jnp.max(real)
+        s_out = _scale(mn_o, mx_o)
+        qo = jnp.clip(jnp.round(real / s_out), -_I8MAX, _I8MAX).astype(
+            jnp.int8)
+        return qo, mn_o, mx_o
+
+    return f
+
+
+register_alias("_contrib_quantized_batch_norm", "quantized_batch_norm")
+
+
+# ---------------------------------------------------------------------------
+# straight-through estimators — contrib/stes_op.cc
+# ---------------------------------------------------------------------------
+def _make_round_ste(**a):
+    @jax.custom_vjp
+    def f(x):
+        return jnp.round(x)
+
+    f.defvjp(lambda x: (jnp.round(x), None), lambda _, g: (g,))
+    return f
+
+
+def _make_sign_ste(**a):
+    @jax.custom_vjp
+    def f(x):
+        return jnp.sign(x)
+
+    f.defvjp(lambda x: (jnp.sign(x), None), lambda _, g: (g,))
+    return f
+
+
+register("round_ste", _make_round_ste)
+register("sign_ste", _make_sign_ste)
+register_alias("_contrib_round_ste", "round_ste")
+register_alias("_contrib_sign_ste", "sign_ste")
+
+
+# ---------------------------------------------------------------------------
+# intgemm bridge — contrib/intgemm/*.cc. The reference wraps the AVX2/AVX512
+# intgemm library; the TPU analog is the same 4-op protocol (maxabsolute →
+# prepare → gemm) lowered onto the MXU int8 path.
+# ---------------------------------------------------------------------------
+register("intgemm_maxabsolute", lambda **a:
+         (lambda x: jnp.max(jnp.abs(x))))
+register_alias("_contrib_intgemm_maxabsolute", "intgemm_maxabsolute")
+
+register("intgemm_prepare_data", lambda **a:
+         (lambda x, maxabs: jnp.clip(
+             jnp.round(x * (_I8MAX / jnp.maximum(maxabs, 1e-12))),
+             -_I8MAX, _I8MAX).astype(jnp.int8)),
+         differentiable=False)
+register_alias("_contrib_intgemm_prepare_data", "intgemm_prepare_data")
+
+# On CPU, prepare_weight lays the matrix out in a CPU-register tiling; the
+# TPU layout is XLA's concern, so preparation = quantization only.
+register("intgemm_prepare_weight", lambda already_quantized=False, **a:
+         (lambda w, *maxabs: w.astype(jnp.int8) if already_quantized
+          else jnp.clip(jnp.round(w * (_I8MAX / jnp.maximum(
+              maxabs[0], 1e-12))), -_I8MAX, _I8MAX).astype(jnp.int8)),
+         differentiable=False)
+register_alias("_contrib_intgemm_prepare_weight", "intgemm_prepare_weight")
+
+register("intgemm_take_weight", lambda **a:
+         (lambda w, idx: jnp.take(w, idx.astype(jnp.int32), axis=0)),
+         differentiable=False)
+register_alias("_contrib_intgemm_take_weight", "intgemm_take_weight")
+
+
+@register("intgemm_fully_connected")
+def _intgemm_fully_connected(out_type="float32", num_hidden=0,
+                             no_bias=True, flatten=True, **a):
+    """C = A_int8 · B_int8^T · scale (+ bias) — intgemm_fully_connected.cc.
+    ``scale`` arrives as the product of the two dequantization scales."""
+    def f(*args):
+        if no_bias:
+            qa, qb_w, scale = args
+            bias = None
+        else:
+            qa, qb_w, scale, bias = args
+        x = qa.reshape(qa.shape[0], -1) if flatten else qa
+        acc = lax.dot_general(
+            x.astype(jnp.int8), qb_w.astype(jnp.int8),
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * scale
+        if bias is not None:
+            out = out + bias
+        if out_type == "int32":
+            return acc
+        return out
+
+    return f
+
+
+register_alias("_contrib_intgemm_fully_connected", "intgemm_fully_connected")

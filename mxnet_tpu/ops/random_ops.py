@@ -1,0 +1,286 @@
+"""Random-sampling operators (registry ops with explicit PRNG-key operand).
+
+TPU-native equivalent of the reference's random op families:
+
+- ``_random_*``  — shape+attr samplers (src/operator/random/sample_op.cc)
+- ``_sample_*``  — per-row parameter tensors: params of shape ``(B,)`` with
+  ``shape=(S,)`` produce ``(B, S)`` draws (src/operator/random/
+  multisample_op.cc)
+- ``_npi_*``     — numpy.random internals (src/operator/numpy/random/*.cc)
+
+Design: every sampler is a registered op with ``needs_rng=True`` — invoke()
+prepends a fresh PRNG key operand, so the op stays a *pure* function. Under
+CachedOp tracing the key becomes a fresh-per-call input, which is what makes
+replayed graphs produce fresh randomness (the reference reaches the same goal
+with the kRandom resource, resource_manager; here it is explicit dataflow,
+the jax idiom — and it shards trivially under pjit).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, register_alias
+
+_F = {"float32": jnp.float32, "float64": jnp.float64,
+      "float16": jnp.float16, "bfloat16": jnp.bfloat16,
+      None: jnp.float32, "None": jnp.float32}
+
+
+def _dt(dtype):
+    return _F.get(dtype, dtype)
+
+
+def _shp(shape):
+    if shape is None:
+        return ()
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+# ---------------------------------------------------------------------------
+# shape+attr samplers — sample_op.cc (params are static attrs)
+# ---------------------------------------------------------------------------
+register("_random_uniform", lambda low=0.0, high=1.0, shape=(),
+         dtype="float32", ctx=None, **a:
+         (lambda key: jax.random.uniform(key, _shp(shape), _dt(dtype),
+                                         low, high)),
+         needs_rng=True, differentiable=False)
+register("_random_normal", lambda loc=0.0, scale=1.0, shape=(),
+         dtype="float32", ctx=None, **a:
+         (lambda key: loc + scale * jax.random.normal(key, _shp(shape),
+                                                      _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_random_gamma", lambda alpha=1.0, beta=1.0, shape=(),
+         dtype="float32", ctx=None, **a:
+         (lambda key: beta * jax.random.gamma(key, alpha, _shp(shape),
+                                              _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_random_exponential", lambda lam=1.0, shape=(), dtype="float32",
+         ctx=None, **a:
+         (lambda key: jax.random.exponential(key, _shp(shape),
+                                             _dt(dtype)) / lam),
+         needs_rng=True, differentiable=False)
+register("_random_poisson", lambda lam=1.0, shape=(), dtype="float32",
+         ctx=None, **a:
+         (lambda key: jax.random.poisson(key, lam, _shp(shape)).astype(
+             _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_random_negative_binomial", lambda k=1, p=1.0, shape=(),
+         dtype="float32", ctx=None, **a:
+         (lambda key: _neg_binomial(key, k, p, _shp(shape), _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_random_generalized_negative_binomial",
+         lambda mu=1.0, alpha=1.0, shape=(), dtype="float32", ctx=None, **a:
+         (lambda key: _gen_neg_binomial(key, mu, alpha, _shp(shape),
+                                        _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_random_randint", lambda low=0, high=1, shape=(), dtype="int32",
+         ctx=None, **a:
+         (lambda key: jax.random.randint(key, _shp(shape), low, high,
+                                         dtype)),
+         needs_rng=True, differentiable=False)
+
+
+def _neg_binomial(key, k, p, shape, dtype):
+    """NB(k, p) as Gamma–Poisson mixture (the reference samples the same
+    way: sampler.h NegativeBinomialSampler)."""
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, shape) * (1.0 - p) / p
+    return jax.random.poisson(kp, lam, shape).astype(dtype)
+
+
+def _gen_neg_binomial(key, mu, alpha, shape, dtype):
+    kg, kp = jax.random.split(key)
+    r = 1.0 / alpha
+    beta = mu * alpha
+    lam = jax.random.gamma(kg, r, shape) * beta
+    return jax.random.poisson(kp, lam, shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# per-row parameter samplers — multisample_op.cc: params are tensor inputs,
+# draw `shape` samples per parameter row
+# ---------------------------------------------------------------------------
+def _rowwise(sampler, nparam):
+    def make(shape=(), dtype="float32", **a):
+        s = _shp(shape)
+
+        def f(key, *params):
+            if len(params) != nparam:
+                raise ValueError(
+                    f"sampler expects {nparam} parameter tensor(s), "
+                    f"got {len(params)}")
+            out_shape = params[0].shape + s
+            broad = [jnp.reshape(p, p.shape + (1,) * len(s))
+                     for p in params]
+            return sampler(key, broad, out_shape).astype(_dt(dtype))
+
+        return f
+
+    return make
+
+
+register("_sample_uniform",
+         _rowwise(lambda key, p, sh: jax.random.uniform(
+             key, sh, minval=0.0, maxval=1.0) * (p[1] - p[0]) + p[0], 2),
+         needs_rng=True, differentiable=False)
+register("_sample_normal",
+         _rowwise(lambda key, p, sh: p[0] + p[1] * jax.random.normal(
+             key, sh), 2),
+         needs_rng=True, differentiable=False)
+register("_sample_gamma",
+         _rowwise(lambda key, p, sh: p[1] * jax.random.gamma(
+             key, jnp.broadcast_to(p[0], sh), sh), 2),
+         needs_rng=True, differentiable=False)
+register("_sample_exponential",
+         _rowwise(lambda key, p, sh: jax.random.exponential(
+             key, sh) / p[0], 1),
+         needs_rng=True, differentiable=False)
+register("_sample_poisson",
+         _rowwise(lambda key, p, sh: jax.random.poisson(
+             key, jnp.broadcast_to(p[0], sh), sh).astype(jnp.float32), 1),
+         needs_rng=True, differentiable=False)
+register("_sample_negative_binomial",
+         _rowwise(lambda key, p, sh: _nb_rows(key, p[0], p[1], sh), 2),
+         needs_rng=True, differentiable=False)
+register("_sample_generalized_negative_binomial",
+         _rowwise(lambda key, p, sh: _gnb_rows(key, p[0], p[1], sh), 2),
+         needs_rng=True, differentiable=False)
+
+
+def _nb_rows(key, k, p, shape):
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, jnp.broadcast_to(k, shape), shape) \
+        * (1.0 - p) / p
+    return jax.random.poisson(kp, lam, shape).astype(jnp.float32)
+
+
+def _gnb_rows(key, mu, alpha, shape):
+    kg, kp = jax.random.split(key)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(kg, jnp.broadcast_to(r, shape), shape) \
+        * (mu * alpha)
+    return jax.random.poisson(kp, lam, shape).astype(jnp.float32)
+
+
+def _make_sample_multinomial(shape=(), get_prob=False, dtype="int32", **a):
+    """_sample_multinomial (multisample_op.cc): data rows are probability
+    vectors; draw `shape` categorical indices per row."""
+    s = _shp(shape)
+
+    def f(key, probs):
+        logits = jnp.log(jnp.clip(probs, 1e-30, None))
+        batch, ncat = probs.shape[:-1], probs.shape[-1]
+        expanded = jnp.broadcast_to(
+            logits.reshape(batch + (1,) * len(s) + (ncat,)),
+            batch + s + (ncat,))
+        out = jax.random.categorical(key, expanded).astype(dtype)
+        if get_prob:
+            lp = jnp.take_along_axis(
+                expanded, out.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+            return out, lp
+        return out
+
+    return f
+
+
+register("_sample_multinomial", _make_sample_multinomial,
+         needs_rng=True, differentiable=False, nout=1)
+register_alias("_npi_multinomial", "_sample_multinomial")
+
+register("_shuffle", lambda **a:
+         (lambda key, x: jax.random.permutation(key, x)),
+         needs_rng=True, differentiable=False)
+register_alias("shuffle", "_shuffle")
+
+# ---------------------------------------------------------------------------
+# numpy.random internals — np_random_op.cc family
+# ---------------------------------------------------------------------------
+register("_npi_uniform", lambda low=0.0, high=1.0, size=None,
+         dtype="float32", ctx=None, **a:
+         (lambda key: jax.random.uniform(key, _shp(size), _dt(dtype),
+                                         low, high)),
+         needs_rng=True, differentiable=False)
+register("_npi_normal", lambda loc=0.0, scale=1.0, size=None,
+         dtype="float32", ctx=None, **a:
+         (lambda key: loc + scale * jax.random.normal(key, _shp(size),
+                                                      _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_npi_bernoulli", lambda prob=0.5, logit=None, size=None,
+         dtype="float32", is_logit=False, ctx=None, **a:
+         (lambda key: jax.random.bernoulli(
+             key, jax.nn.sigmoid(logit) if is_logit else prob,
+             _shp(size)).astype(_dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_npi_exponential", lambda scale=1.0, size=None, ctx=None,
+         dtype="float32", **a:
+         (lambda key: scale * jax.random.exponential(key, _shp(size),
+                                                     _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_npi_gumbel", lambda loc=0.0, scale=1.0, size=None, ctx=None,
+         dtype="float32", **a:
+         (lambda key: loc + scale * jax.random.gumbel(key, _shp(size),
+                                                      _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_npi_laplace", lambda loc=0.0, scale=1.0, size=None, ctx=None,
+         dtype="float32", **a:
+         (lambda key: loc + scale * jax.random.laplace(key, _shp(size),
+                                                       _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_npi_logistic", lambda loc=0.0, scale=1.0, size=None, ctx=None,
+         dtype="float32", **a:
+         (lambda key: loc + scale * jax.random.logistic(key, _shp(size),
+                                                        _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_npi_pareto", lambda a=1.0, size=None, ctx=None,
+         dtype="float32", **kw:
+         (lambda key: jax.random.pareto(key, a, _shp(size),
+                                        _dt(dtype)) - 1.0),
+         needs_rng=True, differentiable=False)
+register("_npi_rayleigh", lambda scale=1.0, size=None, ctx=None,
+         dtype="float32", **a:
+         (lambda key: scale * jnp.sqrt(
+             -2.0 * jnp.log(jax.random.uniform(
+                 key, _shp(size), _dt(dtype), 1e-7, 1.0)))),
+         needs_rng=True, differentiable=False)
+register("_npi_weibull", lambda a=1.0, size=None, ctx=None,
+         dtype="float32", **kw:
+         (lambda key: jnp.power(
+             -jnp.log(jax.random.uniform(key, _shp(size), _dt(dtype),
+                                         1e-7, 1.0)), 1.0 / a)),
+         needs_rng=True, differentiable=False)
+register("_npi_gamma", lambda shape=1.0, scale=1.0, size=None, ctx=None,
+         dtype="float32", **a:
+         (lambda key: scale * jax.random.gamma(key, shape, _shp(size),
+                                               _dt(dtype))),
+         needs_rng=True, differentiable=False)
+register("_npi_choice", lambda a=1, size=None, replace=True, weights=None,
+         ctx=None, **kw:
+         (lambda key, *p: jax.random.choice(
+             key, int(a), _shp(size), replace=replace,
+             p=p[0] if p else None)),
+         needs_rng=True, differentiable=False)
+register("_npi_normal_n", lambda loc=0.0, scale=1.0, size=None,
+         dtype="float32", ctx=None, **a:
+         (lambda key, *p: _param_n(
+             key, p, (loc, scale), _shp(size), _dt(dtype),
+             lambda k, l_, s_, sh: l_ + s_ * jax.random.normal(
+                 k, sh))),
+         needs_rng=True, differentiable=False)
+register("_npi_uniform_n", lambda low=0.0, high=1.0, size=None,
+         dtype="float32", ctx=None, **a:
+         (lambda key, *p: _param_n(
+             key, p, (low, high), _shp(size), _dt(dtype),
+             lambda k, lo, hi, sh: jax.random.uniform(
+                 k, sh, minval=0.0, maxval=1.0) * (hi - lo) + lo)),
+         needs_rng=True, differentiable=False)
+
+
+def _param_n(key, tensor_params, attr_params, size, dtype, draw):
+    """``*_n`` variants (np_random_op.cc): params may arrive as tensors;
+    the output shape is size + broadcast(param shapes)."""
+    p = list(tensor_params) + list(attr_params[len(tensor_params):])
+    a0 = jnp.asarray(p[0], dtype)
+    a1 = jnp.asarray(p[1], dtype)
+    bshape = jnp.broadcast_shapes(a0.shape, a1.shape)
+    return draw(key, a0, a1, size + bshape).astype(dtype)

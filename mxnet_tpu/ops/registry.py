@@ -56,15 +56,19 @@ class Op:
     """A registered operator: pure-fn factory + metadata."""
 
     __slots__ = ("name", "_make_fn", "_fn_cache", "needs_rng", "nout",
-                 "differentiable")
+                 "differentiable", "jit")
 
     def __init__(self, name, make_fn, needs_rng: bool = False, nout=1,
-                 differentiable: bool = True):
+                 differentiable: bool = True, jit: bool = True):
         self.name = name
         self._make_fn = make_fn
         self._fn_cache: dict = {}
         self.needs_rng = needs_rng
         self.nout = nout
+        # jit=False marks eager-only ops with data-dependent output shapes
+        # (reference analog: dynamic-shape ops that fail under hybridize,
+        # e.g. contrib/dynamic_shape_ops.cc) — they run uncompiled.
+        self.jit = jit
         # Declared per-op at registration (reference analog: presence/absence
         # of FGradient, op_attr_types.h). Non-differentiable ops skip the
         # autograd tape; for every other op a failure inside jax.vjp is a real
@@ -88,7 +92,7 @@ class Op:
             f = self._make_fn(**attrs)
             if amp_dt is not None:
                 f = _amp_wrap(f, amp_dt)
-            if _EAGER_JIT:
+            if _EAGER_JIT and self.jit:
                 # jit each op fn: eager calls hit the compiled-program cache
                 # and jax.vjp linearizes against one cached pjit primitive
                 # instead of re-tracing op internals (e.g. RNN scans) every
@@ -102,20 +106,35 @@ class Op:
 
 
 def register(name, make_fn=None, *, needs_rng=False, nout=1,
-             differentiable=True):
+             differentiable=True, jit=True):
     """Register an operator. Usable directly or as a decorator on make_fn."""
 
     def _do(mf):
         if name in _OPS:
             raise MXNetError(f"op '{name}' already registered")
         op = Op(name, mf, needs_rng=needs_rng, nout=nout,
-                differentiable=differentiable)
+                differentiable=differentiable, jit=jit)
         _OPS[name] = op
         return op
 
     if make_fn is None:
         return _do
     return _do(make_fn)
+
+
+def register_alias(alias: str, target: str):
+    """Register ``alias`` as an additional name for op ``target``.
+
+    Mirrors NNVM's ``.add_alias`` (reference: 3rdparty/tvm/nnvm op registry;
+    used throughout src/operator to expose one kernel under legacy CamelCase,
+    ``_npi_*`` and ``_contrib_*`` names, e.g. elemwise_unary_op_basic.cc
+    registers relu + _npx_relu for one FCompute). The alias shares the Op
+    object, so attrs/jit caches are shared too.
+    """
+    if alias in _OPS:
+        raise MXNetError(f"op '{alias}' already registered")
+    _OPS[alias] = get_op(target)
+    return _OPS[alias]
 
 
 def get_op(name: str) -> Op:

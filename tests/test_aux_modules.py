@@ -78,12 +78,18 @@ def test_library_load(tmp_path):
     from mxnet_tpu import library
 
     library.load(str(ext))
-    assert str(ext.resolve()) in [os.path.abspath(p)
-                                  for p in library.loaded_libraries()]
-    from mxnet_tpu.ops.registry import apply_op
+    try:
+        assert str(ext.resolve()) in [os.path.abspath(p)
+                                      for p in library.loaded_libraries()]
+        from mxnet_tpu.ops.registry import apply_op
 
-    out = apply_op("triple_ext", np.array([1.0, 2.0]))
-    assert_almost_equal(out, [3.0, 6.0])
+        out = apply_op("triple_ext", np.array([1.0, 2.0]))
+        assert_almost_equal(out, [3.0, 6.0])
+    finally:
+        # drop the temp op so registry-wide sweeps see only built-in ops
+        from mxnet_tpu.ops.registry import _OPS
+
+        _OPS.pop("triple_ext", None)
 
 
 # ---------------------------------------------------------------- subgraph

@@ -1,0 +1,196 @@
+"""Sliding-window attention, DGL graph sampling, image/cv ops
+(ops/graph_image_ops.py). Reference patterns: tests/python/unittest/
+test_contrib_ops.py (sldwin), test_dgl_graph.py, test_image.py."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.ops.registry import apply_op
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RS = onp.random.RandomState(3)
+
+
+def _nd(a):
+    return NDArray(onp.asarray(a))
+
+
+# ---------------------------------------------------------------- sldwin
+def _dense_band_oracle(q, k, dil, w, symmetric):
+    """Score oracle via dense loops."""
+    B, L, H, D = q.shape
+    W = 2 * w + 1 if symmetric else w + 1
+    offs = range(-w, w + 1) if symmetric else range(-w, 1)
+    out = onp.zeros((B, L, H, W), "float32")
+    for b in range(B):
+        for l in range(L):
+            for h in range(H):
+                for ki, off in enumerate(offs):
+                    j = l + off * int(dil[h])
+                    if 0 <= j < L:
+                        out[b, l, h, ki] = q[b, l, h] @ k[b, j, h]
+    return out
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+def test_sldwin_atten_score_matches_dense(symmetric):
+    B, L, H, D, w = 2, 10, 2, 4, 2
+    q = RS.randn(B, L, H, D).astype("float32")
+    k = RS.randn(B, L, H, D).astype("float32")
+    dil = onp.array([1, 2])
+    got = apply_op("sldwin_atten_score", _nd(q), _nd(k), _nd(dil),
+                   w=w, symmetric=symmetric).asnumpy()
+    want = _dense_band_oracle(q, k, dil, w, symmetric)
+    assert_almost_equal(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sldwin_context_and_mask():
+    B, L, H, D, w = 1, 8, 2, 3, 2
+    q = RS.randn(B, L, H, D).astype("float32")
+    k = RS.randn(B, L, H, D).astype("float32")
+    v = RS.randn(B, L, H, D).astype("float32")
+    dil = onp.array([1, 1])
+    sc = apply_op("sldwin_atten_score", _nd(q), _nd(k), _nd(dil), w=w)
+    mask = apply_op("sldwin_atten_mask_like", sc, _nd(dil),
+                    _nd(onp.array([5])), w=w).asnumpy()
+    # positions >= val_length are fully masked
+    assert mask[0, 5:].sum() == 0
+    # in-range position attends only within the band and the valid length
+    assert mask[0, 4, 0, 2] == 1          # self
+    assert mask[0, 4, 0, 4] == 0          # l+2=6 >= val_length 5
+    ctx = apply_op("sldwin_atten_context", sc, _nd(v), _nd(dil), w=w)
+    assert ctx.shape == (B, L, H, D)
+    # full attention equivalence: window covering the whole sequence
+    w_full = L
+    qf, kf, vf = (RS.randn(1, 4, 1, 3).astype("float32") for _ in range(3))
+    dil1 = onp.array([1])
+    sc_f = apply_op("sldwin_atten_score", _nd(qf), _nd(kf), _nd(dil1),
+                    w=w_full)
+    ctx_f = apply_op("sldwin_atten_context", sc_f, _nd(vf), _nd(dil1),
+                     w=w_full).asnumpy()
+    dense = onp.einsum("blhd,bjhd->blhj", qf, kf)
+    ref = onp.einsum("blhj,bjhd->blhd", dense, vf)
+    assert_almost_equal(ctx_f, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sldwin_score_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    B, L, H, D, w = 1, 5, 1, 2, 1
+    q = _nd(RS.randn(B, L, H, D).astype("float32"))
+    k = _nd(RS.randn(B, L, H, D).astype("float32"))
+    dil = _nd(onp.array([1]))
+    check_numeric_gradient(
+        lambda ins: (apply_op("sldwin_atten_score", ins[0], ins[1], dil,
+                              w=w) ** 2).sum(), [q, k])
+
+
+# ---------------------------------------------------------------- dgl
+_IP = onp.array([0, 2, 4, 5, 6])
+_IX = onp.array([1, 2, 0, 3, 3, 0])
+
+
+def test_dgl_adjacency_and_getnnz():
+    adj = apply_op("dgl_adjacency", _nd(_IP), _nd(_IX)).asnumpy()
+    want = onp.zeros((4, 4), "float32")
+    want[0, [1, 2]] = 1
+    want[1, [0, 3]] = 1
+    want[2, 3] = 1
+    want[3, 0] = 1
+    assert (adj == want).all()
+    assert apply_op("getnnz", _nd(adj)).item() == 6
+    assert apply_op("getnnz", _nd(adj), axis=1).asnumpy().tolist() == \
+        [2, 2, 1, 1]
+
+
+def test_dgl_subgraph_and_compact():
+    ip, ix = apply_op("dgl_subgraph", _nd(_IP), _nd(_IX),
+                      _nd(onp.array([0, 1, 3])))
+    # induced subgraph on {0,1,3}: 0->1, 1->0, 1->3, 3->0
+    assert ip.asnumpy().tolist() == [0, 1, 3, 4]
+    assert ix.asnumpy().tolist() == [1, 0, 2, 0]
+    cip, cix = apply_op("dgl_graph_compact", _nd(_IP), _nd(_IX),
+                        _nd(onp.array([0, 1, -1])))
+    assert cip.asnumpy().tolist() == [0, 1, 2]
+    assert cix.asnumpy().tolist() == [1, 0]
+
+
+def test_dgl_neighbor_sampling():
+    mx.random.seed(5)
+    sv, off = apply_op("dgl_csr_neighbor_uniform_sample", _nd(_IP),
+                       _nd(_IX), _nd(onp.array([0])), num_hops=1,
+                       num_neighbor=2, max_num_vertices=6)
+    s = sv.asnumpy().tolist()
+    assert s[0] == 0 and set(x for x in s[1:] if x >= 0) <= {1, 2}
+    assert off.asnumpy().tolist()[0] == 0
+    prob = onp.array([0.1, 0.0, 0.9, 0.0])
+    sv2, _ = apply_op("dgl_csr_neighbor_non_uniform_sample", _nd(_IP),
+                      _nd(_IX), _nd(prob), _nd(onp.array([0])),
+                      num_hops=1, num_neighbor=1, max_num_vertices=6)
+    s2 = [x for x in sv2.asnumpy().tolist() if x >= 0]
+    assert s2[0] == 0 and (len(s2) == 1 or s2[1] == 2)  # p(1)=0
+    # fewer non-zero-prob neighbors than num_neighbor must not crash,
+    # and zero-prob-only frontiers sample nothing
+    sv3, _ = apply_op("dgl_csr_neighbor_non_uniform_sample", _nd(_IP),
+                      _nd(_IX), _nd(prob), _nd(onp.array([0])),
+                      num_hops=1, num_neighbor=2, max_num_vertices=6)
+    s3 = [x for x in sv3.asnumpy().tolist() if x >= 0]
+    assert s3 == [0, 2]
+    zero_prob = onp.zeros(4)
+    sv4, _ = apply_op("dgl_csr_neighbor_non_uniform_sample", _nd(_IP),
+                      _nd(_IX), _nd(zero_prob), _nd(onp.array([0])),
+                      num_hops=1, num_neighbor=2, max_num_vertices=6)
+    assert [x for x in sv4.asnumpy().tolist() if x >= 0] == [0]
+
+
+def test_edge_id():
+    eid = apply_op("edge_id", _nd(_IP), _nd(_IX),
+                   _nd(onp.array([0, 1, 2])),
+                   _nd(onp.array([2, 3, 1]))).asnumpy()
+    assert eid.tolist() == [1, 3, -1]
+
+
+# ---------------------------------------------------------------- image/cv
+def test_image_ops():
+    img = (RS.rand(16, 12, 3) * 255).astype("uint8")
+    t = apply_op("image_to_tensor", _nd(img))
+    assert t.shape == (3, 16, 12)
+    assert 0.0 <= float(t.asnumpy().min()) and float(t.asnumpy().max()) <= 1.0
+    n = apply_op("image_normalize", t, mean=(0.5, 0.5, 0.5),
+                 std=(0.5, 0.5, 0.5)).asnumpy()
+    assert -1.0 <= n.min() and n.max() <= 1.0
+    r = apply_op("image_resize", _nd(img), size=(8, 8))
+    assert r.shape == (8, 8, 3)
+    # keep_ratio + int size resizes the shorter edge, preserving aspect
+    kr = apply_op("image_resize", _nd(img), size=8, keep_ratio=True)
+    assert kr.shape == (11, 8, 3) or kr.shape == (10, 8, 3)
+    c = apply_op("image_crop", _nd(img), x=2, y=4, width=6, height=8)
+    assert c.shape == (8, 6, 3)
+    assert (c.asnumpy() == img[4:12, 2:8]).all()
+    rc = apply_op("image_random_crop", _nd(img), size=(6, 6))
+    assert rc.shape == (6, 6, 3)
+    rrc = apply_op("image_random_resized_crop", _nd(img), size=(6, 6))
+    assert rrc.shape == (6, 6, 3)
+
+
+def test_cv_ops(tmp_path):
+    img = (RS.rand(10, 10, 3) * 255).astype("uint8")
+    cv = apply_op("cvimresize", _nd(img), w=5, h=5)
+    assert cv.shape == (5, 5, 3)
+    cb = apply_op("cvcopyMakeBorder", _nd(img), top=1, bot=2, left=3,
+                  right=4)
+    assert cb.shape == (13, 17, 3)
+    # PNG round-trip through imdecode/imread
+    try:
+        from PIL import Image
+    except ImportError:
+        pytest.skip("no PIL")
+    p = tmp_path / "t.png"
+    Image.fromarray(img).save(p)
+    rd = apply_op("cvimread", filename=str(p))
+    assert rd.shape == (10, 10, 3)
+    assert (rd.asnumpy() == img).all()
+    buf = onp.frombuffer(p.read_bytes(), dtype="uint8")
+    dec = apply_op("cvimdecode", _nd(buf))
+    assert (dec.asnumpy() == img).all()

@@ -1,0 +1,241 @@
+"""Dynamic-shape manip, control-flow ops, contrib stragglers
+(ops/npi_manip.py). Reference patterns: tests/python/unittest/
+test_numpy_op.py (unique/delete/insert), test_contrib_control_flow.py,
+test_contrib_ops.py (hawkesll)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.ops.registry import apply_op
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RS = onp.random.RandomState(9)
+
+
+def _nd(a):
+    return NDArray(onp.asarray(a))
+
+
+def test_unique_variants():
+    x = onp.array([3, 1, 2, 2, 3, 3], dtype="float32")
+    assert apply_op("unique", _nd(x)).asnumpy().tolist() == [1, 2, 3]
+    vals, counts = apply_op("unique", _nd(x), return_counts=True)
+    assert counts.asnumpy().tolist() == [1, 2, 3]
+    vals, inv = apply_op("unique", _nd(x), return_inverse=True)
+    assert (vals.asnumpy()[inv.asnumpy()] == x).all()
+
+
+def test_nonzero_convention():
+    x = onp.array([[1, 0, 2], [0, 3, 0]])
+    nz = apply_op("nonzero", _nd(x)).asnumpy()
+    assert nz.tolist() == [[0, 0], [0, 2], [1, 1]]  # (N, ndim)
+
+
+def test_boolean_mask_and_assign():
+    data = onp.arange(12).reshape(4, 3).astype("float32")
+    m = onp.array([1, 0, 1, 0])
+    out = apply_op("boolean_mask", _nd(data), _nd(m)).asnumpy()
+    assert (out == data[[0, 2]]).all()
+    # scalar assign is jit-compatible (static shapes): drive it hybridized
+    a = apply_op("_npi_boolean_mask_assign_scalar", _nd(data),
+                 _nd(data > 5), value=-1.0).asnumpy()
+    assert (a == onp.where(data > 5, -1.0, data)).all()
+    t = apply_op("_npi_boolean_mask_assign_tensor", _nd(data),
+                 _nd(data > 5), _nd(onp.full(6, 9.0, "float32"))).asnumpy()
+    want = data.copy()
+    want[data > 5] = 9.0
+    assert (t == want).all()
+
+
+def test_delete_insert():
+    x = onp.arange(6).astype("float32")
+    assert apply_op("delete", _nd(x), _nd(onp.array([0, 5]))).asnumpy() \
+        .tolist() == [1, 2, 3, 4]
+    assert apply_op("delete", _nd(x), start=1, stop=5,
+                    step=2).asnumpy().tolist() == [0, 2, 4, 5]
+    assert apply_op("_npi_insert_scalar", _nd(x), int_ind=0,
+                    val=7.0).asnumpy()[0] == 7.0
+    out = apply_op("_npi_insert_tensor", _nd(x),
+                   _nd(onp.array([8.0, 9.0], "float32")),
+                   _nd(onp.array([1, 3])))
+    assert out.asnumpy().tolist() == [0.0, 8.0, 1.0, 2.0, 9.0, 3.0, 4.0,
+                                      5.0]
+    s = apply_op("_npi_insert_slice", _nd(x),
+                 _nd(onp.array([7.0, 8.0, 9.0], "float32")),
+                 start=0, stop=6, step=2)
+    assert s.asnumpy().tolist() == onp.insert(
+        x, slice(0, 6, 2), [7.0, 8.0, 9.0]).tolist()
+
+
+def test_advanced_indexing():
+    x = RS.randn(4, 5).astype("float32")
+    got = apply_op("advanced_indexing", _nd(x),
+                   _nd(onp.array([3, 1]))).asnumpy()
+    assert (got == x[[3, 1]]).all()
+    got2 = apply_op("advanced_indexing_multiple", _nd(x),
+                    _nd(onp.array([0, 2])), _nd(onp.array([1, 4]))).asnumpy()
+    assert (got2 == x[[0, 2], [1, 4]]).all()
+    b = apply_op("advanced_indexing", _nd(x), _nd(x > 0)).asnumpy()
+    assert (b == x[x > 0]).all()
+
+
+def test_legacy_concat_and_eig_aliases():
+    a, b = onp.ones((2, 2), "float32"), onp.zeros((2, 3), "float32")
+    assert apply_op("Concat", _nd(a), _nd(b), dim=1).shape == (2, 5)
+    m = onp.array([[2.0, 0.0], [0.0, 3.0]], "float32")
+    vals = apply_op("_npi_eigvals", _nd(m)).asnumpy()
+    assert sorted(onp.real(vals).tolist()) == [2.0, 3.0]
+
+
+def test_control_flow_ops():
+    def body(slc, states):
+        return slc + states[0], [states[0] + 1]
+
+    outs = apply_op("_foreach", _nd(onp.arange(4, dtype="float32")),
+                    _nd(onp.array(0.0, "float32")), body=body,
+                    num_states=1)
+    assert outs[0].asnumpy().tolist() == [0.0, 2.0, 4.0, 6.0]
+    assert outs[1].asnumpy() == 4.0
+
+    res = apply_op("_cond", _nd(onp.array(True)),
+                   _nd(onp.array(2.0, "float32")),
+                   then_func=lambda v: v * 2, else_func=lambda v: v * 3)
+    assert res.asnumpy() == 4.0
+
+    outs = apply_op("_while_loop", _nd(onp.array(0.0, "float32")),
+                    cond=lambda v: v < 5, func=lambda v: ([], [v + 2]),
+                    max_iterations=10)
+    final = outs if not isinstance(outs, tuple) else outs[0]
+    assert final.asnumpy() == 6.0
+
+
+def test_hawkesll_matches_analytic_oracle():
+    """Exact log-likelihood of a 1-channel exponential Hawkes process:
+    ll = sum_i log(mu + alpha*sum_{j<i} e^{-beta (t_i-t_j)})
+         - mu*T - sum_i alpha/beta (1 - e^{-beta (T - t_i)})."""
+    mu, alpha, beta, T = 0.5, 0.2, 1.0, 2.0
+    times = [1.0, 2.0]
+    lam1 = mu
+    lam2 = mu + alpha * onp.exp(-beta * 1.0)
+    comp = mu * T + (alpha / beta) * sum(
+        1.0 - onp.exp(-beta * (T - t)) for t in times)
+    want = onp.log(lam1) + onp.log(lam2) - comp
+    ll, _ = apply_op(
+        "hawkesll", _nd(onp.array([mu], "float32")),
+        _nd(onp.array([alpha], "float32")),
+        _nd(onp.array([beta], "float32")),
+        _nd(onp.zeros((1, 1), "float32")),
+        _nd(onp.array([[1.0, 1.0]], "float32")),
+        _nd(onp.zeros((1, 2))), _nd(onp.array([2.0])),
+        _nd(onp.array([T], "float32")))
+    assert_almost_equal(ll.asnumpy()[0], want, rtol=1e-4)
+
+
+def test_hawkesll_decreases_with_fewer_events():
+    K, N, T = 2, 2, 3
+    mu = _nd(onp.array([0.5, 0.5], "float32"))
+    alpha = _nd(onp.array([0.2, 0.2], "float32"))
+    beta = _nd(onp.array([1.0, 1.0], "float32"))
+    state = _nd(onp.zeros((N, K), "float32"))
+    lags = _nd(onp.ones((N, T), "float32"))
+    marks = _nd(onp.zeros((N, T)))
+    vl = _nd(onp.array([3.0, 1.0]))
+    mt = _nd(onp.array([3.0, 3.0], "float32"))
+    ll, new_state = apply_op("hawkesll", mu, alpha, beta, state, lags,
+                             marks, vl, mt)
+    assert ll.shape == (2,) and new_state.shape == (N, K)
+    # row 0 observes 3 events, row 1 only 1 → different log-likelihoods,
+    # both finite and negative for this configuration
+    a, b = ll.asnumpy()
+    assert onp.isfinite([a, b]).all() and a != b
+
+
+def test_hawkesll_gradient_flows():
+    K, N, T = 1, 1, 2
+    mu = _nd(onp.array([0.4], "float32"))
+    mu.attach_grad()
+    with mx.autograd.record():
+        ll, _ = apply_op(
+            "hawkesll", mu, _nd(onp.array([0.1], "float32")),
+            _nd(onp.array([1.0], "float32")),
+            _nd(onp.zeros((N, K), "float32")),
+            _nd(onp.ones((N, T), "float32")),
+            _nd(onp.zeros((N, T))), _nd(onp.array([2.0])),
+            _nd(onp.array([2.0], "float32")))
+        loss = -ll.sum()
+    loss.backward()
+    assert onp.isfinite(mu.grad.asnumpy()).all()
+    assert abs(float(mu.grad.asnumpy()[0])) > 0
+
+
+def test_rroi_align_axis_aligned_matches_crop():
+    data = RS.rand(1, 1, 8, 8).astype("float32")
+    # unrotated ROI centered at (4,4), 4x4 → samples inside [2,6)
+    rois = onp.array([[0, 4.0, 4.0, 4.0, 4.0, 0.0]], "float32")
+    out = apply_op("rroi_align", _nd(data), _nd(rois), pooled_size=(2, 2),
+                   spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 1, 2, 2)
+    assert out.min() >= data.min() and out.max() <= data.max()
+    # 90° rotation of a symmetric ROI keeps samples inside the image
+    rois90 = onp.array([[0, 4.0, 4.0, 4.0, 4.0, 90.0]], "float32")
+    out90 = apply_op("rroi_align", _nd(data), _nd(rois90),
+                     pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert onp.isfinite(out90).all()
+
+
+def test_mrcnn_mask_target_shapes_and_values():
+    B, R, M = 1, 2, 3
+    rois = onp.array([[[0., 0., 15., 15.], [4., 4., 12., 12.]]], "float32")
+    gt = onp.zeros((B, M, 16, 16), "float32")
+    gt[0, 1, :, :] = 1.0
+    matches = onp.array([[1, 1]])
+    cls = onp.array([[1, 0]])
+    mt, mw = apply_op("mrcnn_mask_target", _nd(rois), _nd(gt),
+                      _nd(matches), _nd(cls), num_rois=R,
+                      mask_size=(4, 4), num_classes=2)
+    assert mt.shape == (B, R, 2, 4, 4) and mw.shape == mt.shape
+    # roi 0 matched to all-ones mask, class 1 → target all ones there
+    assert mt.asnumpy()[0, 0, 1].min() == 1.0
+    assert mt.asnumpy()[0, 0, 0].max() == 0.0  # other class zeroed
+
+
+def test_calibrate_entropy_reasonable_threshold():
+    data = RS.randn(20000)
+    h, e = onp.histogram(onp.abs(data), bins=2048, range=(0, 8))
+    mn, mx = apply_op("calibrate_entropy", _nd(h.astype("float32")),
+                      _nd(e.astype("float32")))
+    # optimal clip for a gaussian lands well inside the raw max
+    assert 1.0 < mx.item() < 8.0 and mn.item() == -mx.item()
+    # arbitrary histogram sizes are supported (not just 2048 bins)
+    h2, e2 = onp.histogram(onp.abs(data), bins=512, range=(0, 8))
+    mn2, mx2 = apply_op("calibrate_entropy", _nd(h2.astype("float32")),
+                        _nd(e2.astype("float32")))
+    assert 1.0 < mx2.item() < 8.0
+
+
+def test_custom_op_via_registry_name():
+    from mxnet_tpu import operator as op_mod
+
+    name = "sweep_double"
+    if name not in getattr(op_mod, "_PROPS", {}):
+        @op_mod.register(name)
+        class DoubleProp(op_mod.CustomOpProp):
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                class Double(op_mod.CustomOp):
+                    def forward(self, is_train, req, in_data, out_data,
+                                aux):
+                        self.assign(out_data[0], req[0],
+                                    mx.np.array(
+                                        in_data[0].asnumpy() * 2))
+
+                    def backward(self, req, out_grad, in_data, out_data,
+                                 in_grad, aux):
+                        self.assign(in_grad[0], req[0],
+                                    mx.np.array(
+                                        out_grad[0].asnumpy() * 2))
+
+                return Double()
+
+    out = apply_op("Custom", _nd(onp.array([1.0, 2.0], "float32")),
+                   op_type=name)
+    assert out.asnumpy().tolist() == [2.0, 4.0]

@@ -21,6 +21,19 @@ from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
 RNG = onp.random.RandomState(7)
 
 
+def zlib_seed(name):
+    import zlib
+
+    return zlib.crc32(name.encode()) % (2 ** 31)
+
+
+def _reseed(name):
+    """Per-op deterministic seed: adding/removing sweep entries must not
+    shift the RNG stream of unrelated ops (a near-tie in min/max inputs
+    makes their numeric gradient unstable)."""
+    RNG.seed(zlib_seed(name))
+
+
 # ---------------------------------------------------------------------------
 # element-wise table ops: domains + oracles derived from the op tables
 # ---------------------------------------------------------------------------
@@ -555,6 +568,493 @@ SPECS.update({
 
 
 
+# ---------------------------------------------------------------------------
+# legacy scalar-op family (ops/legacy_elemwise.py) — numpy oracles
+# ---------------------------------------------------------------------------
+_S = 1.7
+_SCALAR_TABLE = {
+    "_plus_scalar": lambda x: x + _S,
+    "_minus_scalar": lambda x: x - _S,
+    "_rminus_scalar": lambda x: _S - x,
+    "_mul_scalar": lambda x: x * _S,
+    "_div_scalar": lambda x: x / _S,
+    "_rdiv_scalar": lambda x: _S / x,
+    "_mod_scalar": lambda x: onp.mod(x, _S),
+    "_rmod_scalar": lambda x: onp.mod(_S, x),
+    "_power_scalar": lambda x: onp.power(x, _S),
+    "_rpower_scalar": lambda x: onp.power(_S, x),
+    "_maximum_scalar": lambda x: onp.maximum(x, _S),
+    "_minimum_scalar": lambda x: onp.minimum(x, _S),
+    "_hypot_scalar": lambda x: onp.hypot(x, onp.float32(_S)),
+    "_npi_copysign_scalar": lambda x: onp.copysign(x, _S),
+    "_npi_rcopysign_scalar": lambda x: onp.copysign(onp.float32(_S), x),
+    "_npi_arctan2_scalar": lambda x: onp.arctan2(x, onp.float32(_S)),
+    "_npi_rarctan2_scalar": lambda x: onp.arctan2(onp.float32(_S), x),
+    "_npi_fmax_scalar": lambda x: onp.fmax(x, _S),
+    "_npi_fmin_scalar": lambda x: onp.fmin(x, _S),
+    "_npi_fmod_scalar": lambda x: onp.fmod(x, _S),
+    "_npi_rfmod_scalar": lambda x: onp.fmod(onp.float32(_S), x),
+    "_npi_ldexp_scalar": lambda x: onp.ldexp(x, int(_S)),
+    "_equal_scalar": lambda x: (x == _S).astype(x.dtype),
+    "_not_equal_scalar": lambda x: (x != _S).astype(x.dtype),
+    "_greater_scalar": lambda x: (x > _S).astype(x.dtype),
+    "_greater_equal_scalar": lambda x: (x >= _S).astype(x.dtype),
+    "_lesser_scalar": lambda x: (x < _S).astype(x.dtype),
+    "_lesser_equal_scalar": lambda x: (x <= _S).astype(x.dtype),
+    "_logical_and_scalar": lambda x: onp.logical_and(x, _S).astype(x.dtype),
+    "_logical_or_scalar": lambda x: onp.logical_or(x, _S).astype(x.dtype),
+    "_logical_xor_scalar": lambda x: onp.logical_xor(x, _S).astype(x.dtype),
+}
+_SCALAR_INT_TABLE = {
+    "_npi_gcd_scalar": lambda x: onp.gcd(x, 2),
+    "_npi_lcm_scalar": lambda x: onp.lcm(x, 2),
+    "_npi_bitwise_and_scalar": lambda x: onp.bitwise_and(x, 2),
+    "_npi_bitwise_or_scalar": lambda x: onp.bitwise_or(x, 2),
+    "_npi_bitwise_xor_scalar": lambda x: onp.bitwise_xor(x, 2),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_SCALAR_TABLE))
+def test_scalar_op_forward(name):
+    x = RNG.uniform(0.3, 2.5, size=(3, 4)).astype("float32")
+    got = apply_op(name, NDArray(x), scalar=_S).asnumpy()
+    assert_almost_equal(got.astype("float64"),
+                        onp.asarray(_SCALAR_TABLE[name](x)).astype("float64"),
+                        rtol=2e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", sorted(_SCALAR_INT_TABLE))
+def test_scalar_int_op_forward(name):
+    x = RNG.randint(1, 6, size=(3, 4)).astype("int32")
+    got = apply_op(name, NDArray(x), scalar=2).asnumpy()
+    assert (got == _SCALAR_INT_TABLE[name](x)).all()
+
+
+def test_npi_ldexp_rscalar():
+    x = onp.array([1, 2, 3], dtype="float32")
+    got = apply_op("_npi_rldexp_scalar", NDArray(x), scalar=1.5).asnumpy()
+    assert_almost_equal(got, onp.ldexp(onp.float32(1.5), x.astype("int32")))
+
+
+def test_where_scalar_variants():
+    c = onp.array([True, False, True])
+    r = onp.array([1.0, 2.0, 3.0], dtype="float32")
+    assert_almost_equal(
+        apply_op("_npi_where_lscalar", NDArray(c), NDArray(r), scalar=9.0),
+        onp.where(c, 9.0, r))
+    assert_almost_equal(
+        apply_op("_npi_where_rscalar", NDArray(c), NDArray(r), scalar=9.0),
+        onp.where(c, r, 9.0))
+    assert_almost_equal(
+        apply_op("_npi_where_scalar2", NDArray(c), x=1.0, y=-1.0),
+        onp.where(c, 1.0, -1.0))
+
+
+def test_grad_through_scalar_and_identity_ops():
+    x = NDArray(onp.array([1.0, -2.0, 3.0], dtype="float32"))
+    check_numeric_gradient(
+        lambda ins: apply_op("_mul_scalar", ins[0], scalar=2.5).sum(), [x])
+    check_numeric_gradient(
+        lambda ins: apply_op("_rdiv_scalar", ins[0], scalar=2.0).sum(),
+        [NDArray(onp.array([1.0, 2.0, 4.0], dtype="float32"))])
+    # make_loss backward = grad_scale regardless of head gradient
+    import mxnet_tpu as _mx
+    y = NDArray(onp.array([1.0, 2.0], dtype="float32"))
+    y.attach_grad()
+    with _mx.autograd.record():
+        z = (apply_op("make_loss", y, grad_scale=3.0) * 5.0).sum()
+    z.backward()
+    assert_almost_equal(y.grad, [3.0, 3.0])
+    # gradientmultiplier scales (and can reverse) the gradient
+    w = NDArray(onp.array([1.0, 2.0], dtype="float32"))
+    w.attach_grad()
+    with _mx.autograd.record():
+        z = (apply_op("gradientmultiplier", w, scalar=-1.0) * 2.0).sum()
+    z.backward()
+    assert_almost_equal(w.grad, [-2.0, -2.0])
+
+
+SPECS.update({
+    # unary extras
+    "reciprocal_sqrt": (lambda: [onp.abs(_f(3, 4)) + 0.2], {},
+                        lambda x: 1.0 / onp.sqrt(x), True),
+    "rcbrt": (lambda: [onp.abs(_f(3, 4)) + 0.2], {},
+              lambda x: 1.0 / onp.cbrt(x), True),
+    "digamma": (lambda: [onp.abs(_f(3, 4)) + 0.5], {}, None, True),
+    "hard_sigmoid": (lambda: [_f(3, 4) * 5], {},
+                     lambda x: onp.clip(0.2 * x + 0.5, 0, 1), False),
+    "nanprod": (lambda: [_f(3, 4)], {"axis": 1},
+                lambda x: onp.nanprod(x, 1), False),
+    "ones_like": (lambda: [_f(3, 4)], {}, lambda x: onp.ones_like(x), False),
+    "zeros_like": (lambda: [_f(3, 4)], {}, lambda x: onp.zeros_like(x),
+                   False),
+    "make_loss": (lambda: [_f(3, 4)], {}, lambda x: x, False),
+    "gradientmultiplier": (lambda: [_f(3, 4)], {"scalar": 2.0},
+                           lambda x: x, False),
+    "IdentityAttachKLSparseReg": (lambda: [onp.abs(_f(3, 4))], {},
+                                  lambda x: x, False),
+    "_grad_add": (lambda: [_f(3, 4), _f(3, 4)], {},
+                  lambda a, b: a + b, True),
+    "add_n": (lambda: [_f(3, 4), _f(3, 4), _f(3, 4)], {},
+              lambda a, b, c: a + b + c, True),
+    "_identity_with_attr_like_rhs": (lambda: [_f(3, 4), _f(3, 4)], {},
+                                     lambda a, b: a, False),
+    "_npx_constraint_check": (lambda: [onp.array([True, True])],
+                              {"msg": "ok"},
+                              lambda x: onp.array(True), False),
+    "div_sqrt_dim": (lambda: [_f(3, 16)], {},
+                     lambda x: x / onp.sqrt(16.0), True),
+    # creation
+    "zeros": (lambda: [], {"shape": (2, 3)},
+              lambda: onp.zeros((2, 3), "float32"), False),
+    "ones": (lambda: [], {"shape": (2, 3)},
+             lambda: onp.ones((2, 3), "float32"), False),
+    "full": (lambda: [], {"shape": (2, 3), "value": 7.0},
+             lambda: onp.full((2, 3), 7.0, "float32"), False),
+    "full_like": (lambda: [_f(2, 3)], {"fill_value": 2.5},
+                  lambda x: onp.full_like(x, 2.5), False),
+    "eye": (lambda: [], {"N": 3, "k": 1},
+            lambda: onp.eye(3, k=1, dtype="float32"), False),
+    "identity": (lambda: [], {"n": 3},
+                 lambda: onp.identity(3, "float32"), False),
+    "arange": (lambda: [], {"start": 2, "stop": 8, "step": 2,
+                            "dtype": "float32"},
+               lambda: onp.arange(2, 8, 2, "float32"), False),
+    "linspace": (lambda: [], {"start": 0.0, "stop": 1.0, "num": 5},
+                 lambda: onp.linspace(0, 1, 5, dtype="float32"), False),
+    "logspace": (lambda: [], {"start": 0.0, "stop": 2.0, "num": 3},
+                 lambda: onp.logspace(0, 2, 3, dtype="float32"), False),
+    "tri": (lambda: [], {"N": 3, "k": 0},
+            lambda: onp.tri(3, dtype="float32"), False),
+    "indices": (lambda: [], {"dimensions": (2, 3)},
+                lambda: onp.indices((2, 3)), False),
+    # stack/split variants
+    "hstack": (lambda: [_f(2, 3), _f(2, 3)], {},
+               lambda a, b: onp.hstack([a, b]), True),
+    "vstack": (lambda: [_f(2, 3), _f(2, 3)], {},
+               lambda a, b: onp.vstack([a, b]), True),
+    "dstack": (lambda: [_f(2, 3), _f(2, 3)], {},
+               lambda a, b: onp.dstack([a, b]), True),
+    "column_stack": (lambda: [_f(3), _f(3)], {},
+                     lambda a, b: onp.column_stack([a, b]), True),
+    "hsplit": (lambda: [_f(2, 4)], {"indices_or_sections": 2},
+               lambda x: onp.hsplit(x, 2)[0], False),
+    "dsplit": (lambda: [_f(2, 3, 4)], {"indices_or_sections": 2},
+               lambda x: onp.dsplit(x, 2)[0], False),
+    # legacy slice family
+    "slice": (lambda: [_f(4, 5)], {"begin": (1, 0), "end": (3, 4)},
+              lambda x: x[1:3, 0:4], True),
+    "slice_axis": (lambda: [_f(4, 5)], {"axis": 1, "begin": 1, "end": 4},
+                   lambda x: x[:, 1:4], True),
+    "slice_like": (lambda: [_f(4, 5), _f(2, 3)], {},
+                   lambda x, y: x[:2, :3], True),
+    "broadcast_axis": (lambda: [_f(1, 4)], {"axis": 0, "size": 3},
+                       lambda x: onp.broadcast_to(x, (3, 4)), True),
+    "broadcast_like": (lambda: [_f(1, 4), _f(3, 4)], {},
+                       lambda x, y: onp.broadcast_to(x, (3, 4)), True),
+    "reshape_like": (lambda: [_f(2, 6), _f(3, 4)], {},
+                     lambda x, y: x.reshape(3, 4), True),
+    "Reshape": (lambda: [_f(3, 4)], {"shape": (-1, 0)},
+                lambda x: x.reshape(3, 4), True),
+    "_npx_reshape": (lambda: [_f(3, 4)], {"newshape": (-2, -1)},
+                     lambda x: x.reshape(3, 4), True),
+    "SliceChannel": (lambda: [_f(4, 6)], {"num_outputs": 2, "axis": 1},
+                     lambda x: onp.split(x, 2, 1)[0], False),
+    "_split_v2": (lambda: [_f(4, 6)], {"sections": 3, "axis": 1},
+                  lambda x: onp.split(x, 3, 1)[0], False),
+    "swapaxes_legacy": (lambda: [_f(3, 4, 2)], {"dim1": 0, "dim2": 2},
+                        lambda x: x.swapaxes(0, 2), True),
+    "_rnn_param_concat": (lambda: [_f(2, 3), _f(4)], {},
+                          lambda a, b: onp.concatenate(
+                              [a.ravel(), b.ravel()]), False),
+    # scatter / assignment
+    "scatter_nd": (lambda: [_f(2), onp.array([[0, 1], [1, 2]])],
+                   {"shape": (3, 4)}, None, False),
+    "_scatter_set_nd": (lambda: [_f(2), onp.array([[0, 1], [1, 2]])],
+                        {"shape": (3, 4)}, None, False),
+    "_slice_assign": (lambda: [_f(4, 5), _f(2, 5)],
+                      {"begin": (1,), "end": (3,)}, None, False),
+    "_slice_assign_scalar": (lambda: [_f(4, 5)],
+                             {"begin": (1,), "end": (3,), "scalar": 9.0},
+                             None, False),
+    # sparse-storage helpers
+    "cast_storage": (lambda: [_f(3, 4)], {"stype": "default"},
+                     lambda x: x, False),
+    "_sparse_retain": (lambda: [_f(5, 3), onp.array([1, 3])], {}, None,
+                       False),
+    "square_sum": (lambda: [_f(3, 4)], {"axis": 1},
+                   lambda x: (x * x).sum(1), True),
+    # multi-tensor helpers
+    "multi_sum_sq": (lambda: [_f(3), _f(4)], {"num_arrays": 2},
+                     lambda a, b: (a * a).sum(), False),
+    "reset_arrays": (lambda: [_f(3), _f(4)], {"num_arrays": 2},
+                     lambda a, b: onp.zeros(3, "float32"), False),
+    "multi_lars": (lambda: [onp.full(3, 0.1, "float32"),
+                            onp.full(3, 4.0, "float32"),
+                            onp.full(3, 1.0, "float32"),
+                            onp.zeros(3, "float32")],
+                   {"eta": 1.0, "eps": 0.0},
+                   lambda lr, w, g, wd: lr * onp.sqrt(w) / onp.sqrt(g),
+                   False),
+    "histogram": (lambda: [_f(32)], {"bin_cnt": 4, "range": (-1, 1)},
+                  None, False),
+    # contrib misc
+    "index_array": (lambda: [_f(2, 3)], {}, None, False),
+    "_npi_share_memory": (lambda: [_f(2), _f(2)], {},
+                          lambda a, b: onp.array(False), False),
+    "_npi_diag_indices_from": (lambda: [_f(3, 3)], {},
+                               lambda x: onp.diag_indices_from(x)[0], False),
+    "_contrib_dynamic_reshape": (lambda: [_f(3, 4), onp.array([4, 3])],
+                                 {}, lambda x, s: x.reshape(4, 3), False),
+    # legacy NN extras
+    "lrn": (lambda: [onp.abs(_f(1, 8, 2, 2)) + 0.1], {"nsize": 5}, None,
+            True),
+    "softmax_activation": (lambda: [_f(2, 5)], {"mode": "instance"},
+                           None, True),
+    "batch_norm_with_relu": (
+        lambda: [_f(2, 3, 4, 4), onp.ones(3, "float32"),
+                 onp.zeros(3, "float32"), onp.zeros(3, "float32"),
+                 onp.ones(3, "float32")], {}, None, False),
+    "sync_batch_norm": (
+        lambda: [_f(2, 3, 4, 4), onp.ones(3, "float32"),
+                 onp.zeros(3, "float32"), onp.zeros(3, "float32"),
+                 onp.ones(3, "float32")], {}, None, False),
+})
+
+
+_R1 = (lambda: [onp.array(1.0, "float32")])
+SPECS.update({
+    # mixed-precision single-tensor updates (ops/optimizer_ops.py)
+    "mp_sgd_update": (lambda: [_f(4), _f(4), _f(4)], {"lr": 0.1},
+                      None, False),
+    "mp_sgd_mom_update": (lambda: [_f(4), _f(4), _f(4), _f(4)],
+                          {"lr": 0.1}, None, False),
+    "mp_nag_mom_update": (lambda: [_f(4), _f(4), _f(4), _f(4)],
+                          {"lr": 0.1}, None, False),
+    "mp_lamb_update_phase1": (lambda: [_f(4), _f(4), _f(4),
+                                       onp.abs(_f(4)), _f(4)],
+                              {"t": 1}, None, False),
+    "mp_lamb_update_phase2": (lambda: [_f(4), _f(4), onp.array([1.0]),
+                                       onp.array([1.0]), _f(4)],
+                              {"lr": 0.01}, None, False),
+    "mp_adamw_update": (lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4)),
+                                 _f(4), onp.array(1.0, "float32")],
+                        {"lr": 0.01}, None, False),
+    "mp_adabelief_update": (lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4)),
+                                     _f(4), onp.array(1.0, "float32")],
+                            {"lr": 0.01}, None, False),
+    # multi-tensor updates — interleaved reference operand layout
+    "multi_sgd_mom_update": (lambda: [_f(3), _f(3), _f(3),
+                                      _f(4), _f(4), _f(4)],
+                             {"lrs": (0.1, 0.1), "wds": (0.0, 0.0),
+                              "num_weights": 2}, None, False),
+    "multi_mp_sgd_update": (lambda: [_f(3), _f(3), _f(3)],
+                            {"lrs": (0.1,), "wds": (0.0,),
+                             "num_weights": 1}, None, False),
+    "multi_mp_sgd_mom_update": (lambda: [_f(3), _f(3), _f(3), _f(3)],
+                                {"lrs": (0.1,), "wds": (0.0,),
+                                 "num_weights": 1}, None, False),
+    "preloaded_multi_sgd_update": (
+        lambda: [_f(3), _f(3), onp.array([0.1], "float32"),
+                 onp.array([0.0], "float32")],
+        {"num_weights": 1}, None, False),
+    "preloaded_multi_sgd_mom_update": (
+        lambda: [_f(3), _f(3), _f(3), onp.array([0.1], "float32"),
+                 onp.array([0.0], "float32")],
+        {"num_weights": 1}, None, False),
+    "preloaded_multi_mp_sgd_update": (
+        lambda: [_f(3), _f(3), _f(3), onp.array([0.1], "float32"),
+                 onp.array([0.0], "float32")],
+        {"num_weights": 1}, None, False),
+    "preloaded_multi_mp_sgd_mom_update": (
+        lambda: [_f(3), _f(3), _f(3), _f(3), onp.array([0.1], "float32"),
+                 onp.array([0.0], "float32")],
+        {"num_weights": 1}, None, False),
+    "multi_adamw_update": (
+        lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4)),
+                 onp.array(1.0, "float32")],
+        {"lrs": (0.01,), "wds": (0.01,), "etas": (1.0,),
+         "num_weights": 1}, None, False),
+    "multi_mp_adamw_update": (
+        lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4)), _f(4),
+                 onp.array(1.0, "float32")],
+        {"lrs": (0.01,), "wds": (0.01,), "etas": (1.0,),
+         "num_weights": 1}, None, False),
+    "multi_lamb_update": (
+        lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4))],
+        {"learning_rates": (0.01,), "wds": (0.0,), "step_count": (1,),
+         "num_tensors": 1}, None, False),
+    "multi_mp_lamb_update": (
+        lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4)), _f(4)],
+        {"learning_rates": (0.01,), "wds": (0.0,), "step_count": (1,),
+         "num_tensors": 1}, None, False),
+    "multi_lans_update": (
+        lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4))],
+        {"learning_rates": (0.01,), "wds": (0.0,), "step_count": (1,),
+         "num_tensors": 1}, None, False),
+    "multi_mp_lans_update": (
+        lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4)), _f(4)],
+        {"learning_rates": (0.01,), "wds": (0.0,), "step_count": (1,),
+         "num_tensors": 1}, None, False),
+    "multi_adabelief_update": (
+        lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4)),
+                 onp.array(1.0, "float32")],
+        {"lrs": (0.01,), "wds": (0.0,), "etas": (1.0,),
+         "num_weights": 1}, None, False),
+    "multi_mp_adabelief_update": (
+        lambda: [_f(4), _f(4), _f(4), onp.abs(_f(4)), _f(4),
+                 onp.array(1.0, "float32")],
+        {"lrs": (0.01,), "wds": (0.0,), "etas": (1.0,),
+         "num_weights": 1}, None, False),
+})
+
+
+def test_mp_sgd_matches_fp32_master():
+    """mp update must track the fp32 master, not the low-precision weight."""
+    w32 = onp.linspace(-1, 1, 8).astype("float32")
+    w16 = w32.astype("float16")
+    g = onp.full(8, 0.5, "float32")
+    w_out, w32_out = apply_op("mp_sgd_update", NDArray(w16),
+                              NDArray(g.astype("float16")), NDArray(w32),
+                              lr=0.1)
+    assert_almost_equal(w32_out, w32 - 0.1 * 0.5, rtol=1e-6)
+    assert str(w_out.dtype) == "float16"
+
+
+# ---------------------------------------------------------------------------
+# random sampler ops (ops/random_ops.py): each draws N samples and checks
+# the first two moments against the analytic distribution
+# (reference pattern: tests/python/unittest/test_random.py)
+# ---------------------------------------------------------------------------
+_N = 4000
+# name -> (attrs, expected_mean, expected_std, tol)
+_SAMPLER_SPECS = {
+    "_random_uniform": ({"low": 2.0, "high": 4.0, "shape": (_N,)},
+                        3.0, 2.0 / 12 ** 0.5, 0.1),
+    "_random_normal": ({"loc": 1.0, "scale": 2.0, "shape": (_N,)},
+                       1.0, 2.0, 0.15),
+    "_random_gamma": ({"alpha": 2.0, "beta": 3.0, "shape": (_N,)},
+                      6.0, 18 ** 0.5, 0.3),
+    "_random_exponential": ({"lam": 2.0, "shape": (_N,)}, 0.5, 0.5, 0.05),
+    "_random_poisson": ({"lam": 4.0, "shape": (_N,)}, 4.0, 2.0, 0.2),
+    "_random_negative_binomial": ({"k": 3, "p": 0.5, "shape": (_N,)},
+                                  3.0, 6 ** 0.5, 0.25),
+    "_random_generalized_negative_binomial":
+        ({"mu": 2.0, "alpha": 0.5, "shape": (_N,)},
+         2.0, (2.0 + 0.5 * 4.0) ** 0.5, 0.25),
+    "_npi_uniform": ({"low": 0.0, "high": 1.0, "size": (_N,)},
+                     0.5, 1 / 12 ** 0.5, 0.05),
+    "_npi_normal": ({"loc": 0.0, "scale": 1.0, "size": (_N,)},
+                    0.0, 1.0, 0.08),
+    "_npi_exponential": ({"scale": 2.0, "size": (_N,)}, 2.0, 2.0, 0.2),
+    "_npi_gumbel": ({"loc": 0.0, "scale": 1.0, "size": (_N,)},
+                    0.5772, 3.14159 / 6 ** 0.5, 0.12),
+    "_npi_laplace": ({"loc": 0.0, "scale": 1.0, "size": (_N,)},
+                     0.0, 2 ** 0.5, 0.12),
+    "_npi_logistic": ({"loc": 0.0, "scale": 1.0, "size": (_N,)},
+                      0.0, 3.14159 / 3 ** 0.5, 0.15),
+    "_npi_pareto": ({"a": 3.0, "size": (_N,)}, 0.5, 0.75 ** 0.5, 0.2),
+    "_npi_rayleigh": ({"scale": 2.0, "size": (_N,)},
+                      2.0 * (3.14159 / 2) ** 0.5, None, 0.15),
+    "_npi_weibull": ({"a": 2.0, "size": (_N,)}, 0.8862, None, 0.1),
+    "_npi_gamma": ({"shape": 2.0, "scale": 3.0, "size": (_N,)},
+                   6.0, 18 ** 0.5, 0.3),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_SAMPLER_SPECS))
+def test_sampler_moments(name):
+    import mxnet_tpu as _mx
+
+    _mx.random.seed(zlib_seed(name))
+    attrs, mean, std, tol = _SAMPLER_SPECS[name]
+    draws = apply_op(name, **attrs).asnumpy().astype("float64")
+    assert abs(draws.mean() - mean) < 4 * tol, (draws.mean(), mean)
+    if std is not None:
+        assert abs(draws.std() - std) < 6 * tol, (draws.std(), std)
+
+
+def test_sampler_bernoulli_and_randint():
+    import mxnet_tpu as _mx
+
+    _mx.random.seed(11)
+    b = apply_op("_npi_bernoulli", prob=0.3, size=(_N,)).asnumpy()
+    assert abs(b.mean() - 0.3) < 0.05 and set(onp.unique(b)) <= {0.0, 1.0}
+    r = apply_op("_random_randint", low=2, high=7,
+                 shape=(_N,)).asnumpy()
+    assert r.min() >= 2 and r.max() <= 6
+
+
+def test_sampler_rowwise_and_choice():
+    import mxnet_tpu as _mx
+
+    _mx.random.seed(13)
+    lo = NDArray(onp.array([0.0, 10.0], dtype="float32"))
+    hi = NDArray(onp.array([1.0, 20.0], dtype="float32"))
+    u = apply_op("_sample_uniform", lo, hi, shape=(500,)).asnumpy()
+    assert u.shape == (2, 500)
+    assert abs(u[0].mean() - 0.5) < 0.1 and abs(u[1].mean() - 15.0) < 1.0
+    n = apply_op("_sample_normal", lo, hi, shape=(500,)).asnumpy()
+    assert abs(n[0].mean()) < 0.2
+    g = apply_op("_sample_gamma",
+                 NDArray(onp.array([2.0], dtype="float32")),
+                 NDArray(onp.array([3.0], dtype="float32")),
+                 shape=(2000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.8
+    e = apply_op("_sample_exponential",
+                 NDArray(onp.array([2.0], dtype="float32")),
+                 shape=(2000,)).asnumpy()
+    assert abs(e.mean() - 0.5) < 0.1
+    p = apply_op("_sample_poisson",
+                 NDArray(onp.array([4.0], dtype="float32")),
+                 shape=(2000,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.4
+    nb = apply_op("_sample_negative_binomial",
+                  NDArray(onp.array([3.0], dtype="float32")),
+                  NDArray(onp.array([0.5], dtype="float32")),
+                  shape=(2000,)).asnumpy()
+    assert abs(nb.mean() - 3.0) < 0.6
+    gnb = apply_op("_sample_generalized_negative_binomial",
+                   NDArray(onp.array([2.0], dtype="float32")),
+                   NDArray(onp.array([0.5], dtype="float32")),
+                   shape=(2000,)).asnumpy()
+    assert abs(gnb.mean() - 2.0) < 0.6
+    c = apply_op("_npi_choice", a=5, size=(300,)).asnumpy()
+    assert c.min() >= 0 and c.max() <= 4
+    m = apply_op("_sample_multinomial",
+                 NDArray(onp.array([[0.1, 0.9], [0.9, 0.1]],
+                                   dtype="float32")),
+                 shape=(500,)).asnumpy()
+    assert m.shape == (2, 500)
+    assert m[0].mean() > 0.8 and m[1].mean() < 0.2
+    o, lp = apply_op("_sample_multinomial",
+                     NDArray(onp.array([0.5, 0.5], dtype="float32")),
+                     shape=(4,), get_prob=True)
+    assert_almost_equal(lp, onp.full(4, onp.log(0.5)), rtol=1e-5)
+    nn = apply_op("_npi_normal_n",
+                  NDArray(onp.array([0.0, 5.0], dtype="float32")),
+                  NDArray(onp.array([1.0, 1.0], dtype="float32")),
+                  size=(400,)).asnumpy()
+    assert nn.shape == (400, 2) and abs(nn[:, 1].mean() - 5.0) < 0.3
+    un = apply_op("_npi_uniform_n",
+                  NDArray(onp.array([0.0], dtype="float32")),
+                  NDArray(onp.array([2.0], dtype="float32")),
+                  size=(400,)).asnumpy()
+    assert abs(un.mean() - 1.0) < 0.2
+    s = apply_op("_shuffle",
+                 NDArray(onp.arange(8, dtype="float32"))).asnumpy()
+    assert sorted(s.tolist()) == list(range(8))
+
+
+_SAMPLER_COVERED = set(_SAMPLER_SPECS) | {
+    "_npi_bernoulli", "_random_randint", "_sample_uniform",
+    "_sample_normal", "_sample_gamma", "_sample_exponential",
+    "_sample_poisson", "_sample_negative_binomial",
+    "_sample_generalized_negative_binomial", "_sample_multinomial",
+    "_npi_choice", "_npi_normal_n", "_npi_uniform_n", "_shuffle",
+}
+
+
 # ops proven in dedicated test files (sweep exemption must name the file)
 COVERED_ELSEWHERE = {
     "batch_norm": "test_operator_nn.py",
@@ -581,19 +1081,89 @@ COVERED_ELSEWHERE = {
     "linalg_eigh": "test_numpy_op.py (linalg)",
     "linalg_eigvalsh": "test_numpy_op.py (linalg)",
     "linalg_matrix_rank": "test_numpy_op.py (linalg)",
+    # int8 quantized family — dequantize-vs-fp32 oracles
+    "quantize_v2": "test_quantized_ops.py",
+    "requantize": "test_quantized_ops.py",
+    "quantized_act": "test_quantized_ops.py",
+    "quantized_flatten": "test_quantized_ops.py",
+    "quantized_concat": "test_quantized_ops.py",
+    "quantized_elemwise_add": "test_quantized_ops.py",
+    "quantized_elemwise_mul": "test_quantized_ops.py",
+    "quantized_embedding": "test_quantized_ops.py",
+    "quantized_fully_connected_v2": "test_quantized_ops.py",
+    "quantized_conv": "test_quantized_ops.py",
+    "quantized_pooling": "test_quantized_ops.py",
+    "quantized_batch_norm": "test_quantized_ops.py",
+    "round_ste": "test_quantized_ops.py",
+    "sign_ste": "test_quantized_ops.py",
+    "intgemm_maxabsolute": "test_quantized_ops.py",
+    "intgemm_prepare_data": "test_quantized_ops.py",
+    "intgemm_prepare_weight": "test_quantized_ops.py",
+    "intgemm_take_weight": "test_quantized_ops.py",
+    "intgemm_fully_connected": "test_quantized_ops.py",
+    # sldwin attention / dgl graph / image-cv tiers
+    "sldwin_atten_score": "test_graph_image_ops.py",
+    "sldwin_atten_context": "test_graph_image_ops.py",
+    "sldwin_atten_mask_like": "test_graph_image_ops.py",
+    "dgl_adjacency": "test_graph_image_ops.py",
+    "dgl_subgraph": "test_graph_image_ops.py",
+    "dgl_csr_neighbor_uniform_sample": "test_graph_image_ops.py",
+    "dgl_csr_neighbor_non_uniform_sample": "test_graph_image_ops.py",
+    "dgl_graph_compact": "test_graph_image_ops.py",
+    "edge_id": "test_graph_image_ops.py",
+    "getnnz": "test_graph_image_ops.py",
+    "image_to_tensor": "test_graph_image_ops.py",
+    "image_normalize": "test_graph_image_ops.py",
+    "image_resize": "test_graph_image_ops.py",
+    "image_crop": "test_graph_image_ops.py",
+    "image_random_crop": "test_graph_image_ops.py",
+    "image_random_resized_crop": "test_graph_image_ops.py",
+    "cvimresize": "test_graph_image_ops.py",
+    "cvcopyMakeBorder": "test_graph_image_ops.py",
+    "cvimdecode": "test_graph_image_ops.py",
+    "cvimread": "test_graph_image_ops.py",
+    # dynamic-shape manip / control flow / contrib stragglers
+    "unique": "test_npi_manip_ops.py",
+    "nonzero": "test_npi_manip_ops.py",
+    "boolean_mask": "test_npi_manip_ops.py",
+    "_npi_boolean_mask_assign_scalar": "test_npi_manip_ops.py",
+    "_npi_boolean_mask_assign_tensor": "test_npi_manip_ops.py",
+    "delete": "test_npi_manip_ops.py",
+    "_npi_insert_scalar": "test_npi_manip_ops.py",
+    "_npi_insert_slice": "test_npi_manip_ops.py",
+    "_npi_insert_tensor": "test_npi_manip_ops.py",
+    "advanced_indexing": "test_npi_manip_ops.py",
+    "advanced_indexing_multiple": "test_npi_manip_ops.py",
+    "Concat": "test_npi_manip_ops.py",
+    "_foreach": "test_npi_manip_ops.py (+ test_control_flow.py)",
+    "_while_loop": "test_npi_manip_ops.py (+ test_control_flow.py)",
+    "_cond": "test_npi_manip_ops.py (+ test_control_flow.py)",
+    "hawkesll": "test_npi_manip_ops.py",
+    "mrcnn_mask_target": "test_npi_manip_ops.py",
+    "rroi_align": "test_npi_manip_ops.py",
+    "calibrate_entropy": "test_npi_manip_ops.py",
+    "Custom": "test_npi_manip_ops.py (+ test_aux_modules.py)",
 }
 
 
 def test_registry_fully_covered():
     """EVERY registered op is swept here, in a table sweep, or explicitly
-    mapped to its dedicated test file."""
-    table = set(_UNARY_NAMES) | set(_BINARY_NAMES)
+    mapped to its dedicated test file. A name registered via register_alias
+    (Op.name != key) is covered by its target's coverage — the alias shares
+    the implementation, so one sweep proves both names."""
+    table = (set(_UNARY_NAMES) | set(_BINARY_NAMES) | set(_SCALAR_TABLE)
+             | set(_SCALAR_INT_TABLE) | _SAMPLER_COVERED
+             | {"_npi_rldexp_scalar", "_npi_where_lscalar",
+                "_npi_where_rscalar", "_npi_where_scalar2"})
+    covered = table | set(SPECS) | set(COVERED_ELSEWHERE)
     missing = []
-    for name in _OPS:
+    for name, op in _OPS.items():
         if name.startswith("_test_"):
             continue
-        if name in table or name in SPECS or name in COVERED_ELSEWHERE:
+        if name in covered:
             continue
+        if op.name != name and op.name in covered:
+            continue  # alias of a covered op
         missing.append(name)
     assert not missing, (
         f"ops with no sweep coverage: {sorted(missing)} — add a SPECS entry "
@@ -603,6 +1173,7 @@ def test_registry_fully_covered():
 @pytest.mark.parametrize("name", sorted(SPECS))
 def test_spec_forward(name):
     build, attrs, oracle, _ = SPECS[name]
+    _reseed(name)
     ins = build()
     outs = apply_op(name, *[NDArray(x) for x in ins], **attrs)
     first = outs[0] if isinstance(outs, (tuple, list)) else outs
@@ -625,6 +1196,7 @@ _GRAD_SPECS = sorted(n for n, s in SPECS.items() if s[3])
 @pytest.mark.parametrize("name", _GRAD_SPECS)
 def test_spec_numeric_gradient(name):
     build, attrs, _, _ = SPECS[name]
+    _reseed(name)
     ins = [NDArray(x) for x in build()]
 
     def loss(xs):

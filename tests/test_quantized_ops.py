@@ -1,0 +1,163 @@
+"""Quantized (int8) op family + intgemm bridge (ops/quantized_ops.py).
+
+Reference pattern: tests/python/quantization/test_quantization.py — each
+quantized op is checked against its fp32 counterpart after dequantization.
+"""
+import numpy as onp
+
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.ops.registry import apply_op
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RS = onp.random.RandomState(0)
+
+
+def _nd(a):
+    return NDArray(onp.asarray(a))
+
+
+def _s(mn, mx):
+    return max(abs(mn.item()), abs(mx.item())) / 127.0
+
+
+def test_quantize_v2_roundtrip():
+    x = RS.randn(5, 7).astype("float32")
+    q, mn, mx = apply_op("quantize_v2", _nd(x))
+    assert str(q.dtype) == "int8"
+    deq = q.asnumpy().astype("float32") * _s(mn, mx)
+    assert onp.abs(deq - x).max() < _s(mn, mx)  # within one quantum
+
+
+def test_quantize_v2_calibrated_range():
+    x = RS.randn(64).astype("float32")
+    q, mn, mx = apply_op("quantize_v2", _nd(x), min_calib_range=-2.0,
+                         max_calib_range=2.0)
+    assert mn.item() == -2.0 and mx.item() == 2.0
+    assert int(q.asnumpy().max()) <= 127
+
+
+def test_quantized_fully_connected_matches_fp32():
+    x = RS.randn(4, 8).astype("float32")
+    w = RS.randn(16, 8).astype("float32")
+    qx, mnx, mxx = apply_op("quantize_v2", _nd(x))
+    qw, mnw, mxw = apply_op("quantize_v2", _nd(w))
+    out, mn, mx = apply_op("quantized_fully_connected_v2", qx, qw,
+                           mnx, mxx, mnw, mxw, no_bias=True, num_hidden=16)
+    s_out = max(abs(mn.item()), abs(mx.item())) / (2 ** 31 - 1)
+    deq = out.asnumpy().astype("float64") * s_out
+    ref = x @ w.T
+    rel = onp.abs(deq - ref).max() / onp.abs(ref).max()
+    assert rel < 0.05, rel
+
+
+def test_quantized_conv_and_requantize():
+    x = RS.randn(1, 3, 8, 8).astype("float32")
+    w = RS.randn(4, 3, 3, 3).astype("float32")
+    qx, a1, a2 = apply_op("quantize_v2", _nd(x))
+    qw, b1, b2 = apply_op("quantize_v2", _nd(w))
+    out, mn, mx = apply_op("quantized_conv", qx, qw, a1, a2, b1, b2,
+                           kernel=(3, 3), num_filter=4)
+    assert out.shape == (1, 4, 6, 6) and str(out.dtype) == "int32"
+    s_out = max(abs(mn.item()), abs(mx.item())) / (2 ** 31 - 1)
+    import jax.numpy as jnp  # noqa: F401
+    from jax import lax
+
+    ref = onp.asarray(lax.conv_general_dilated(
+        x, w, (1, 1), ((0, 0), (0, 0))))
+    rel = onp.abs(out.asnumpy() * s_out - ref).max() / onp.abs(ref).max()
+    assert rel < 0.08, rel
+    q8, mn8, mx8 = apply_op("requantize", out, mn, mx)
+    assert str(q8.dtype) == "int8"
+    s8 = _s(mn8, mx8)
+    rel8 = onp.abs(q8.asnumpy() * s8 - ref).max() / onp.abs(ref).max()
+    assert rel8 < 0.1, rel8
+
+
+def test_quantized_act_pool_flatten_concat():
+    x = RS.randn(2, 4, 6, 6).astype("float32")
+    q, mn, mx = apply_op("quantize_v2", _nd(x))
+    r, rmn, rmx = apply_op("quantized_act", q, mn, mx, act_type="relu")
+    assert int(r.asnumpy().min()) >= 0 and rmn.item() >= 0
+    p, _, _ = apply_op("quantized_pooling", q, mn, mx, kernel=(2, 2),
+                       stride=(2, 2), pool_type="max")
+    assert p.shape == (2, 4, 3, 3)
+    ap, _, _ = apply_op("quantized_pooling", q, mn, mx, kernel=(2, 2),
+                        stride=(2, 2), pool_type="avg")
+    assert ap.shape == (2, 4, 3, 3)
+    fl, _, _ = apply_op("quantized_flatten", q, mn, mx)
+    assert fl.shape == (2, 4 * 6 * 6)
+    c, cmn, cmx = apply_op("quantized_concat", q, q, mn, mx, mn, mx,
+                           dim=1, num_args=2)
+    assert c.shape == (2, 8, 6, 6)
+
+
+def test_quantized_elemwise_and_embedding():
+    x = RS.randn(3, 5).astype("float32")
+    q, mn, mx = apply_op("quantize_v2", _nd(x))
+    m, mmn, mmx = apply_op("quantized_elemwise_mul", q, q, mn, mx, mn, mx)
+    s_out = max(abs(mmn.item()), abs(mmx.item())) / (2 ** 31 - 1)
+    assert_almost_equal(m.asnumpy() * s_out, x * x, rtol=0.05, atol=0.05)
+    a, amn, amx = apply_op("quantized_elemwise_add", q, q, mn, mx, mn, mx)
+    assert_almost_equal(a.asnumpy() / 2 ** 16, 2 * x, rtol=0.05, atol=0.05)
+    w = RS.randn(10, 4).astype("float32")
+    qw, wmn, wmx = apply_op("quantize_v2", _nd(w))
+    e, _, _ = apply_op("quantized_embedding", _nd(onp.array([1, 3])), qw,
+                       wmn, wmx)
+    assert e.shape == (2, 4)
+    assert (e.asnumpy() == qw.asnumpy()[[1, 3]]).all()
+
+
+def test_quantized_batch_norm():
+    x = RS.randn(2, 3, 4, 4).astype("float32")
+    q, mn, mx = apply_op("quantize_v2", _nd(x))
+    gamma = onp.array([1.0, 2.0, 0.5], "float32")
+    beta = onp.array([0.0, 1.0, -1.0], "float32")
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    qo, mno, mxo = apply_op("quantized_batch_norm", q, _nd(gamma),
+                            _nd(beta), _nd(mean), _nd(var), mn, mx)
+    s_out = _s(mno, mxo)
+    ref = (x - mean.reshape(1, 3, 1, 1)) / onp.sqrt(
+        var.reshape(1, 3, 1, 1) + 1e-3) * gamma.reshape(1, 3, 1, 1) \
+        + beta.reshape(1, 3, 1, 1)
+    assert onp.abs(qo.asnumpy() * s_out - ref).max() < 0.15
+
+
+def test_ste_gradients():
+    import mxnet_tpu as mx
+
+    v = _nd(onp.array([0.3, -0.7], dtype="float32"))
+    v.attach_grad()
+    with mx.autograd.record():
+        y = (apply_op("round_ste", v) * onp.array([2.0, 3.0],
+                                                  dtype="float32")).sum()
+    y.backward()
+    assert_almost_equal(v.grad, [2.0, 3.0])
+    w = _nd(onp.array([0.3, -0.7], dtype="float32"))
+    w.attach_grad()
+    with mx.autograd.record():
+        y = apply_op("sign_ste", w).sum()
+    y.backward()
+    assert_almost_equal(w.grad, [1.0, 1.0])
+
+
+def test_intgemm_protocol():
+    x = RS.randn(4, 8).astype("float32")
+    w = RS.randn(16, 8).astype("float32")
+    ma = apply_op("intgemm_maxabsolute", _nd(x))
+    mw = apply_op("intgemm_maxabsolute", _nd(w))
+    assert_almost_equal(ma, onp.abs(x).max(), rtol=1e-6)
+    qd = apply_op("intgemm_prepare_data", _nd(x), ma)
+    qw = apply_op("intgemm_prepare_weight", _nd(w), mw)
+    assert str(qd.dtype) == "int8" and str(qw.dtype) == "int8"
+    taken = apply_op("intgemm_take_weight", qw, _nd(onp.array([0, 2])))
+    assert (taken.asnumpy() == qw.asnumpy()[[0, 2]]).all()
+    scale = _nd(onp.float32(ma.item() * mw.item() / 127.0 / 127.0))
+    out = apply_op("intgemm_fully_connected", qd, qw, scale, no_bias=True)
+    ref = x @ w.T
+    rel = onp.abs(out.asnumpy() - ref).max() / onp.abs(ref).max()
+    assert rel < 0.05, rel
+    # int32 accumulator output mode
+    acc = apply_op("intgemm_fully_connected", qd, qw, scale, no_bias=True,
+                   out_type="int32")
+    assert str(acc.dtype) == "int32"
