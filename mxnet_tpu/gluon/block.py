@@ -122,7 +122,11 @@ class Block:
                 d = p.data().asnumpy() if str(p.dtype) != "bfloat16" else \
                     p.data().astype("float32").asnumpy()
                 arrays[name] = d
-        onp.savez(filename, **arrays)
+        # write through a file object: onp.savez on a *name* appends .npz,
+        # which breaks the reference's `.params` filename convention
+        # (save_parameters("x.params") must create exactly x.params)
+        with open(filename, "wb") as fh:
+            onp.savez(fh, **arrays)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,

@@ -15,6 +15,8 @@ from .mobilenet import (mobilenet1_0, mobilenet0_75, mobilenet0_5,
                         MobileNet, MobileNetV2)
 from .squeezenet import squeezenet1_0, squeezenet1_1, SqueezeNet
 from .inception import inception_v3, Inception3
+from .ssd import (SSD, ssd_300_mobilenet, ssd_256_lite, ssd_target,
+                  ssd_detect)
 
 _models = {}
 
@@ -36,6 +38,8 @@ def _register_models():
         "mobilenetv2_0.5": mobilenet_v2_0_5,
         "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
         "inceptionv3": inception_v3,
+        "ssd_300_mobilenet": ssd_300_mobilenet,
+        "ssd_256_lite": ssd_256_lite,
     })
 
 

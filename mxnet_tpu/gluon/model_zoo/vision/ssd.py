@@ -1,0 +1,137 @@
+"""SSD single-shot object detector (model-zoo detection family).
+
+Reference: the SSD architecture the reference ships as
+example/ssd (symbol/symbol_builder.py multi-layer feature extraction +
+MultiBoxPrior/MultiBoxTarget/MultiBoxDetection ops,
+src/operator/contrib/multibox_*.cc) — rebuilt here as a HybridBlock over
+this framework's multibox op tier. TPU notes: every head is a conv over a
+static feature pyramid (one fused XLA program under hybridize); anchors are
+compile-time constants folded into the graph; decoding + NMS
+(multibox_detection) runs as a bounded-shape op so inference jits whole.
+
+Layout contract (matches the reference ops):
+- ``cls_preds``: (B, num_anchors, num_classes+1) — raw logits, background
+  class first (softmax is applied at detection time inside ``ssd_detect``);
+- ``box_preds``: (B, num_anchors * 4) center-form offsets;
+- ``anchors``:   (1, num_anchors, 4) corner-form in [0, 1].
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .... import numpy_extension as npx
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["SSD", "ssd_300_mobilenet", "ssd_256_lite",
+           "ssd_target", "ssd_detect"]
+
+
+def _feature_block(channels, stride):
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels // 2, 1, activation="relu"))
+    blk.add(nn.Conv2D(channels, 3, strides=stride, padding=1,
+                      activation="relu"))
+    return blk
+
+
+class SSD(HybridBlock):
+    """Multi-scale single-shot detector.
+
+    Parameters mirror the reference builder: per-scale anchor ``sizes`` and
+    ``ratios`` lists (len == number of pyramid levels).
+    """
+
+    def __init__(self, num_classes=20, base_channels=(32, 64, 128),
+                 pyramid_channels=(128, 128, 128),
+                 sizes=((0.1, 0.15), (0.25, 0.35), (0.5, 0.7)),
+                 ratios=((1.0, 2.0, 0.5),) * 3, base=None, **kwargs):
+        super().__init__(**kwargs)
+        assert len(pyramid_channels) == len(sizes) == len(ratios)
+        self.num_classes = num_classes
+        self._sizes = tuple(tuple(s) for s in sizes)
+        self._ratios = tuple(tuple(r) for r in ratios)
+
+        if base is not None:
+            # caller-supplied feature extractor (e.g. a zoo backbone trunk)
+            self.base = base
+        else:
+            self.base = nn.HybridSequential()
+            for i, c in enumerate(base_channels):
+                self.base.add(nn.Conv2D(c, 3, padding=1,
+                                        activation="relu"))
+                self.base.add(nn.Conv2D(c, 3, padding=1,
+                                        activation="relu"))
+                self.base.add(nn.MaxPool2D(2))
+
+        self.stages = nn.HybridSequential()
+        self.cls_heads = nn.HybridSequential()
+        self.box_heads = nn.HybridSequential()
+        for i, c in enumerate(pyramid_channels):
+            self.stages.add(_feature_block(c, 1 if i == 0 else 2))
+            na = len(self._sizes[i]) + len(self._ratios[i]) - 1
+            self.cls_heads.add(nn.Conv2D(na * (num_classes + 1), 3,
+                                         padding=1))
+            self.box_heads.add(nn.Conv2D(na * 4, 3, padding=1))
+
+    def forward(self, x):
+        f = self.base(x)
+        cls_list, box_list, anchor_list = [], [], []
+        for stage, ch, bh, sizes, ratios in zip(
+                self.stages, self.cls_heads, self.box_heads,
+                self._sizes, self._ratios):
+            f = stage(f)
+            anchor_list.append(npx.multibox_prior(f, sizes=sizes,
+                                                  ratios=ratios))
+            c = ch(f)           # (B, na*(C+1), H, W)
+            b = bh(f)           # (B, na*4, H, W)
+            B = c.shape[0]
+            cls_list.append(
+                c.transpose((0, 2, 3, 1)).reshape(
+                    (B, -1, self.num_classes + 1)))
+            box_list.append(b.transpose((0, 2, 3, 1)).reshape((B, -1)))
+        from .... import numpy as np
+
+        cls_preds = np.concatenate(cls_list, axis=1)
+        box_preds = np.concatenate(box_list, axis=1)
+        anchors = np.concatenate(anchor_list, axis=1)
+        return cls_preds, box_preds, anchors
+
+
+def ssd_target(anchors, cls_preds, labels, overlap_threshold=0.5,
+               negative_mining_ratio=3.0):
+    """Training targets via the multibox matcher (multibox_target.cc):
+    returns (loc_target, loc_mask, cls_target)."""
+    return npx.multibox_target(
+        anchors, cls_preds.transpose((0, 2, 1)), labels,
+        overlap_threshold=overlap_threshold,
+        negative_mining_ratio=negative_mining_ratio)
+
+
+def ssd_detect(cls_preds, box_preds, anchors, nms_threshold=0.45,
+               threshold=0.01, nms_topk=400):
+    """Decode + per-class NMS → (B, N, 6) rows [cls, score, x1, y1, x2, y2]
+    (multibox_detection.cc)."""
+    cls_prob = npx.softmax(cls_preds, axis=-1).transpose((0, 2, 1))
+    return npx.multibox_detection(
+        cls_prob, box_preds, anchors, nms_threshold=nms_threshold,
+        threshold=threshold, nms_topk=nms_topk)
+
+
+def ssd_300_mobilenet(num_classes=20, multiplier=1.0, **kwargs):
+    """SSD-300 with a genuine MobileNet backbone: the depthwise-separable
+    trunk up to stride 16 (reference SSD-mobilenet pairing), then 3
+    pyramid levels with stride-2 feature blocks."""
+    from .mobilenet import MobileNet
+
+    trunk = MobileNet(multiplier=multiplier).features[:12]  # stride 16
+    return SSD(num_classes=num_classes, base=trunk,
+               pyramid_channels=(256, 256, 128), **kwargs)
+
+
+def ssd_256_lite(num_classes=20, **kwargs):
+    """Small SSD for tests / edge: thin base and pyramid."""
+    return SSD(num_classes=num_classes, base_channels=(16, 32),
+               pyramid_channels=(64, 64),
+               sizes=((0.15, 0.25), (0.4, 0.6)),
+               ratios=((1.0, 2.0, 0.5),) * 2, **kwargs)

@@ -404,7 +404,7 @@ def _multibox_target(overlap_threshold=0.5, negative_mining_ratio=-1.0,
 
     var = jnp.asarray(variances)
 
-    def one(anchors, label):
+    def one(anchors, cls_pred, label):
         valid = label[:, 0] >= 0
         gt = label[:, 1:5]
         iou = _pair_iou(anchors, gt)               # (N, M)
@@ -433,11 +433,26 @@ def _multibox_target(overlap_threshold=0.5, negative_mining_ratio=-1.0,
         loc_m = jnp.where(pos[:, None],
                           jnp.ones_like(t), 0.0).reshape(-1)
         cls_t = jnp.where(pos, label[best_gt, 0] + 1, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negative mining (multibox_target.cc MiningBackward):
+            # keep only the ratio*num_pos hardest negatives — ranked by
+            # max non-background confidence of cls_pred — train the rest
+            # as ignore (-1)
+            conf = jnp.max(cls_pred[1:, :], axis=0)  # (N,) hardest first
+            neg_score = jnp.where(pos, -jnp.inf, conf)
+            order = jnp.argsort(-neg_score)          # best negatives first
+            rank = jnp.zeros_like(order).at[order].set(
+                jnp.arange(order.shape[0]))
+            budget = negative_mining_ratio * jnp.sum(pos)
+            keep_neg = (~pos) & (rank < budget)
+            cls_t = jnp.where(pos | keep_neg, cls_t, -1.0)
         return loc_t, loc_m, cls_t
 
     def f(anchors, cls_preds, label):
+        # cls_preds layout (B, num_classes+1, N) — reference operand order
         anc = anchors.reshape(-1, 4)
-        lt, lm, ct = jax.vmap(lambda lb: one(anc, lb))(label)
+        lt, lm, ct = jax.vmap(
+            lambda cp, lb: one(anc, cp, lb))(cls_preds, label)
         return lt, lm, ct
 
     return f

@@ -1,0 +1,122 @@
+"""SSD detection model family (gluon/model_zoo/vision/ssd.py).
+
+Reference pattern: the reference's example/ssd training/eval flow on the
+multibox op tier (multibox_prior/target/detection) — here as a zoo model.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+from mxnet_tpu.gluon.model_zoo.vision import (get_model, ssd_256_lite,
+                                              ssd_detect, ssd_target)
+
+RS = onp.random.RandomState(0)
+
+
+def _toy_batch():
+    x = np.array(RS.rand(2, 3, 32, 32).astype("float32"))
+    labels = np.array(onp.array(
+        [[[0, .1, .1, .4, .4]], [[1, .5, .5, .9, .9]]], "float32"))
+    return x, labels
+
+
+def test_ssd_forward_contract():
+    net = ssd_256_lite(num_classes=2)
+    net.initialize()
+    x, _ = _toy_batch()
+    cls_p, box_p, anchors = net(x)
+    a = anchors.shape[1]
+    assert cls_p.shape == (2, a, 3)
+    assert box_p.shape == (2, a * 4)
+    assert anchors.shape == (1, a, 4)
+    an = anchors.asnumpy()
+    assert an.min() >= -0.5 and an.max() <= 1.5  # normalized corner form
+
+
+@pytest.mark.parametrize("hybridize", [False, True])
+def test_ssd_trains_and_detects(hybridize):
+    net = ssd_256_lite(num_classes=2)
+    net.initialize()
+    if hybridize:
+        net.hybridize()
+    x, labels = _toy_batch()
+    tr = mx.gluon.Trainer(net.collect_params(), "sgd",
+                          {"learning_rate": 0.05})
+    losses = []
+    for _ in range(4):
+        with mx.autograd.record():
+            cls_p, box_p, anchors = net(x)
+            lt, lm, ct = ssd_target(anchors, cls_p, labels)
+            keep = ct >= 0  # mined-away negatives carry ignore label -1
+            logp = npx.log_softmax(cls_p, axis=-1)
+            nll = -npx.pick(logp, np.maximum(ct, 0), axis=-1) * keep
+            box_loss = npx.smooth_l1((box_p - lt) * lm, scalar=1.0).mean()
+            loss = nll.sum() / keep.sum() + box_loss
+        loss.backward()
+        tr.step(2)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
+    out = ssd_detect(cls_p, box_p, anchors)
+    o = out.asnumpy()
+    assert o.shape[2] == 6
+    kept = o[o[..., 0] >= 0]
+    assert (kept[:, 1] >= 0.0).all() and (kept[:, 1] <= 1.0).all()
+
+
+def test_ssd_target_matches_gt_anchor():
+    """The anchor with best IoU against each gt must be positive."""
+    net = ssd_256_lite(num_classes=2)
+    net.initialize()
+    x, labels = _toy_batch()
+    cls_p, box_p, anchors = net(x)
+    lt, lm, ct = ssd_target(anchors, cls_p, labels)
+    assert int((ct.asnumpy() > 0).sum()) >= 2  # one per image minimum
+    # loc mask nonzero exactly where positives are
+    pos = (ct.asnumpy() > 0)
+    mask = lm.asnumpy().reshape(2, -1, 4).max(axis=-1) > 0
+    assert (mask == pos).all()
+
+
+def test_ssd_zoo_entries():
+    assert get_model("ssd_256_lite", num_classes=3).num_classes == 3
+    net = get_model("ssd_300_mobilenet", num_classes=5)
+    net.initialize()
+    x = np.array(RS.rand(1, 3, 64, 64).astype("float32"))
+    cls_p, box_p, anchors = net(x)
+    assert cls_p.shape[2] == 6
+    assert box_p.shape[1] == anchors.shape[1] * 4
+
+
+def test_ssd_save_load_roundtrip(tmp_path):
+    net = ssd_256_lite(num_classes=2)
+    net.initialize()
+    x, _ = _toy_batch()
+    ref = net(x)[0].asnumpy()
+    p = str(tmp_path / "ssd.params")
+    net.save_parameters(p)
+    net2 = ssd_256_lite(num_classes=2)
+    net2.load_parameters(p)
+    assert onp.allclose(net2(x)[0].asnumpy(), ref)
+
+
+def test_ssd_hard_negative_mining():
+    """negative_mining_ratio=r keeps only the r*num_pos hardest negatives;
+    the rest become ignore (-1) (reference MultiBoxTarget mining)."""
+    net = ssd_256_lite(num_classes=2)
+    net.initialize()
+    x, labels = _toy_batch()
+    cls_p, box_p, anchors = net(x)
+    lt, lm, ct = ssd_target(anchors, cls_p, labels,
+                            negative_mining_ratio=3.0)
+    c = ct.asnumpy()
+    n_pos = (c > 0).sum(axis=1)
+    n_neg = (c == 0).sum(axis=1)
+    n_ign = (c == -1).sum(axis=1)
+    assert (n_ign > 0).all()                      # most anchors ignored
+    assert (n_neg <= 3 * n_pos).all()             # mining budget respected
+    assert (n_pos + n_neg + n_ign == c.shape[1]).all()
+    # mining disabled: every non-positive anchor trains as background
+    _, _, ct_all = ssd_target(anchors, cls_p, labels,
+                              negative_mining_ratio=-1.0)
+    assert (ct_all.asnumpy() >= 0).all()
