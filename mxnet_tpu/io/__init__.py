@@ -17,7 +17,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import recordio as _recordio
 
-__all__ = ["DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+__all__ = ["DataBatch", "DataIter", "NDArrayIter", "CSVIter", "LibSVMIter",
            "ImageRecordIter", "ResizeIter", "PrefetchingIter"]
 
 
@@ -178,19 +178,26 @@ class NDArrayIter(DataIter):
 
 
 class CSVIter(DataIter):
-    """CSV reader (reference: src/io iter_csv.cc)."""
+    """CSV reader (reference: src/io iter_csv.cc) backed by the native
+    threaded float scanner (src/io_native/textparse.cc), numpy fallback."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, **kwargs):
-        data = onp.loadtxt(data_csv, delimiter=",", dtype="float32")
+        from ._textparse import parse_csv
+
+        data = parse_csv(str(data_csv))
         data = data.reshape((-1,) + tuple(data_shape))
         label = None
         if label_csv is not None:
-            label = onp.loadtxt(label_csv, delimiter=",", dtype="float32")
+            label = parse_csv(str(label_csv))
+            if tuple(label_shape) == (1,):
+                label = label.reshape(-1)
         self._inner = NDArrayIter(data, label, batch_size, **kwargs)
         super().__init__(batch_size)
 
     def __getattr__(self, name):
+        if name == "_inner":  # half-built instance (pickle/failed init)
+            raise AttributeError(name)
         return getattr(self._inner, name)
 
     def __next__(self):
@@ -198,6 +205,70 @@ class CSVIter(DataIter):
 
     def reset(self):
         self._inner.reset()
+
+
+class LibSVMIter(DataIter):
+    """LibSVM sparse reader (reference: src/io/iter_libsvm.cc): rows are
+    ``label idx:val ...``; batches come out as dense (batch, num_features)
+    slices of the CSR matrix plus the label vector. Only one batch is ever
+    densified at a time (the file is libsvm BECAUSE the data is sparse —
+    the full dense matrix may not fit in host memory); dense static-shape
+    batches are the TPU-correct form that feeds the MXU. The CSR triple
+    stays on host and is available via the ``csr`` attribute."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 last_batch_handle="pad", **kwargs):
+        from ._textparse import parse_libsvm
+
+        labels, indptr, indices, values = parse_libsvm(str(data_libsvm))
+        self._labels = labels
+        self._indptr = indptr
+        self._indices = indices
+        self._values = values
+        self._num_feat = int(data_shape[0]) if data_shape else \
+            (int(indices.max()) + 1 if indices.size else 1)
+        self._cursor = 0
+        self._last_batch_handle = last_batch_handle
+        super().__init__(batch_size)
+        self.provide_data = [("data", (batch_size, self._num_feat))]
+        self.provide_label = [("softmax_label", (batch_size,))]
+
+    @property
+    def csr(self):
+        return self._indptr, self._indices, self._values
+
+    def _dense_rows(self, rows):
+        out = onp.zeros((len(rows), self._num_feat), "float32")
+        ip, ix, vs = self._indptr, self._indices, self._values
+        counts = ip[rows + 1] - ip[rows]
+        flat_r = onp.repeat(onp.arange(len(rows)), counts)
+        flat_i = onp.concatenate(
+            [onp.arange(ip[r], ip[r + 1]) for r in rows]) if len(rows) \
+            else onp.zeros(0, "int64")
+        cols = ix[flat_i]
+        keep = cols < self._num_feat
+        out[flat_r[keep], cols[keep]] = vs[flat_i][keep]
+        return out
+
+    def __next__(self):
+        n = len(self._labels)
+        if self._cursor >= n:
+            raise StopIteration
+        idx = onp.arange(self._cursor,
+                         min(self._cursor + self.batch_size, n))
+        pad = self.batch_size - len(idx)
+        if pad and self._last_batch_handle == "discard":
+            self._cursor = n
+            raise StopIteration
+        if pad:  # wrap around (reference "pad" semantics)
+            idx = onp.concatenate([idx, onp.arange(pad)])
+        self._cursor += self.batch_size
+        data = NDArray(self._dense_rows(idx))
+        label = NDArray(self._labels[idx])
+        return DataBatch(data=[data], label=[label], pad=pad)
+
+    def reset(self):
+        self._cursor = 0
 
 
 class ImageRecordIter(DataIter):

@@ -1,0 +1,59 @@
+"""Shared build-and-load scaffolding for the native IO libraries.
+
+One loader for every src/io_native/*.cc engine (reference analog: the
+legacy ctypes C API loader, python/mxnet/base.py _LIB): compile on first
+use with the ambient C++ toolchain, cache the .so next to the package,
+rebuild when the source is newer, and return None when neither a binary
+nor a toolchain exists so callers take their pure-python fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.normpath(os.path.join(_HERE, "..", "..", "src",
+                                         "io_native"))
+_CXX = os.environ.get("CXX", "g++")
+_FLAGS = ["-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread", "-shared"]
+
+
+class NativeLib:
+    """Lazily-built ctypes library with per-lib locking."""
+
+    def __init__(self, src_name: str, so_name: str, configure):
+        self._src = os.path.join(_SRC_DIR, src_name)
+        self._so = os.path.join(_HERE, so_name)
+        self._configure = configure
+        self._lock = threading.Lock()
+        self._lib = None
+        self._tried = False
+
+    def _build(self) -> bool:
+        try:
+            subprocess.run([_CXX, *_FLAGS, "-o", self._so, self._src],
+                           check=True, capture_output=True, timeout=120)
+            return True
+        except (OSError, subprocess.SubprocessError):
+            return False
+
+    def get(self):
+        with self._lock:
+            if self._lib is not None or self._tried:
+                return self._lib
+            self._tried = True
+            stale = os.path.exists(self._src) and os.path.exists(self._so) \
+                and os.path.getmtime(self._src) > os.path.getmtime(self._so)
+            if not os.path.exists(self._so) or stale:
+                if not os.path.exists(self._src) or not self._build():
+                    if not os.path.exists(self._so):
+                        return None
+            try:
+                lib = ctypes.CDLL(self._so)
+            except OSError:
+                return None
+            self._configure(lib)
+            self._lib = lib
+            return self._lib

@@ -1,81 +1,52 @@
 """ctypes binding for the native RecordIO engine (src/io_native/recordio.cc).
 
 Reference analog: the legacy ctypes C API loader (python/mxnet/base.py _LIB).
-The library builds on demand with g++ (no pybind dependency); if no toolchain
-is available the callers fall back to the pure-python recordio path.
+Build/load scaffolding is shared with the other native IO engines via
+``_cbuild.NativeLib``; callers fall back to the pure-python recordio path
+when no binary and no toolchain is available.
 """
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "src", "io_native",
-                                     "recordio.cc"))
-_SO = os.path.join(_HERE, "librecordio.so")
-_lock = threading.Lock()
-_lib = None
-_tried = False
+from ._cbuild import NativeLib
 
 
-def _build() -> bool:
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-Wall", "-pthread",
-             "-shared", "-o", _SO, _SRC],
-            check=True, capture_output=True, timeout=120)
-        return True
-    except (OSError, subprocess.SubprocessError):
-        return False
+def _configure(lib):
+    lib.rio_writer_open.restype = ctypes.c_void_p
+    lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rio_writer_write.restype = ctypes.c_int
+    lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64]
+    lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.rio_reader_open.restype = ctypes.c_void_p
+    lib.rio_reader_open.argtypes = [ctypes.c_char_p]
+    lib.rio_reader_count.restype = ctypes.c_uint64
+    lib.rio_reader_count.argtypes = [ctypes.c_void_p]
+    lib.rio_reader_size.restype = ctypes.c_uint32
+    lib.rio_reader_size.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rio_reader_offset.restype = ctypes.c_uint64
+    lib.rio_reader_offset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rio_reader_get.restype = ctypes.c_int
+    lib.rio_reader_get.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                   ctypes.c_char_p]
+    lib.rio_reader_free.argtypes = [ctypes.c_void_p]
+    lib.rio_prefetch_create.restype = ctypes.c_void_p
+    lib.rio_prefetch_create.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64, ctypes.c_uint64]
+    lib.rio_prefetch_next.restype = ctypes.c_int64
+    lib.rio_prefetch_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.rio_prefetch_release.argtypes = [ctypes.c_void_p]
+    lib.rio_prefetch_free.argtypes = [ctypes.c_void_p]
+
+
+_NATIVE = NativeLib("recordio.cc", "librecordio.so", _configure)
 
 
 def get_lib():
     """Load (building if needed) the native library; None if unavailable."""
-    global _lib, _tried
-    with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        if not os.path.exists(_SO) or (
-                os.path.exists(_SRC) and
-                os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
-            if not os.path.exists(_SRC) or not _build():
-                if not os.path.exists(_SO):
-                    return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
-            return None
-        lib.rio_writer_open.restype = ctypes.c_void_p
-        lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
-        lib.rio_writer_write.restype = ctypes.c_int
-        lib.rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                         ctypes.c_uint64]
-        lib.rio_writer_close.argtypes = [ctypes.c_void_p]
-        lib.rio_reader_open.restype = ctypes.c_void_p
-        lib.rio_reader_open.argtypes = [ctypes.c_char_p]
-        lib.rio_reader_count.restype = ctypes.c_uint64
-        lib.rio_reader_count.argtypes = [ctypes.c_void_p]
-        lib.rio_reader_size.restype = ctypes.c_uint32
-        lib.rio_reader_size.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.rio_reader_offset.restype = ctypes.c_uint64
-        lib.rio_reader_offset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
-        lib.rio_reader_get.restype = ctypes.c_int
-        lib.rio_reader_get.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
-                                       ctypes.c_char_p]
-        lib.rio_reader_free.argtypes = [ctypes.c_void_p]
-        lib.rio_prefetch_create.restype = ctypes.c_void_p
-        lib.rio_prefetch_create.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
-            ctypes.c_uint64, ctypes.c_uint64]
-        lib.rio_prefetch_next.restype = ctypes.c_int64
-        lib.rio_prefetch_next.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
-            ctypes.POINTER(ctypes.c_uint64)]
-        lib.rio_prefetch_release.argtypes = [ctypes.c_void_p]
-        lib.rio_prefetch_free.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    return _NATIVE.get()

@@ -1,0 +1,107 @@
+"""ctypes binding for the native text parsers (src/io_native/textparse.cc).
+
+Reference analog: dmlc-core's threaded CSV/LibSVM parsers behind
+src/io/iter_csv.cc and iter_libsvm.cc. Falls back to numpy parsing when the
+toolchain/library is unavailable or the native parser rejects malformed
+input — callers never need to care.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as onp
+
+from ._cbuild import NativeLib
+
+
+def _configure(lib):
+    lib.tp_csv_parse.restype = ctypes.POINTER(ctypes.c_float)
+    lib.tp_csv_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_char,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.tp_libsvm_parse.restype = ctypes.c_int
+    lib.tp_libsvm_parse.argtypes = [
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float))]
+    lib.tp_free.argtypes = [ctypes.POINTER(ctypes.c_float)]
+    lib.tp_free_i64.argtypes = [ctypes.POINTER(ctypes.c_int64)]
+
+
+_NATIVE = NativeLib("textparse.cc", "libtextparse.so", _configure)
+
+
+def get_lib():
+    return _NATIVE.get()
+
+
+def parse_csv(path: str, delimiter: str = ",") -> onp.ndarray:
+    """Parse a CSV of floats into a (rows, cols) float32 array using the
+    threaded native scanner. Malformed input (ragged rows, non-numeric
+    tokens) makes the native parser bail, and the strict numpy path
+    reports the error."""
+    lib = get_lib()
+    if lib is not None:
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        buf = lib.tp_csv_parse(path.encode(), delimiter.encode(),
+                               ctypes.byref(rows), ctypes.byref(cols))
+        if buf:
+            n = rows.value * cols.value
+            out = onp.ctypeslib.as_array(buf, shape=(n,)).astype(
+                "float32", copy=True).reshape(rows.value, cols.value)
+            lib.tp_free(buf)
+            return out
+    return onp.loadtxt(path, delimiter=delimiter,
+                       dtype="float32", ndmin=2)
+
+
+def parse_libsvm(path: str):
+    """Parse LibSVM text into (labels, indptr, indices, values) — the CSR
+    triple plus per-row labels."""
+    lib = get_lib()
+    if lib is not None:
+        nrows = ctypes.c_int64()
+        nnz = ctypes.c_int64()
+        indptr = ctypes.POINTER(ctypes.c_int64)()
+        indices = ctypes.POINTER(ctypes.c_int64)()
+        values = ctypes.POINTER(ctypes.c_float)()
+        labels = ctypes.POINTER(ctypes.c_float)()
+        rc = lib.tp_libsvm_parse(path.encode(), ctypes.byref(nrows),
+                                 ctypes.byref(nnz), ctypes.byref(indptr),
+                                 ctypes.byref(indices),
+                                 ctypes.byref(values), ctypes.byref(labels))
+        if rc == 0:
+            n, z = nrows.value, nnz.value
+            ip = onp.ctypeslib.as_array(indptr, shape=(n + 1,)).astype(
+                "int64", copy=True)
+            ix = onp.ctypeslib.as_array(
+                indices, shape=(max(1, z),))[:z].astype("int64", copy=True)
+            vs = onp.ctypeslib.as_array(
+                values, shape=(max(1, z),))[:z].astype("float32", copy=True)
+            lb = onp.ctypeslib.as_array(
+                labels, shape=(max(1, n),))[:n].astype("float32", copy=True)
+            lib.tp_free_i64(indptr)
+            lib.tp_free_i64(indices)
+            lib.tp_free(values)
+            lib.tp_free(labels)
+            return lb, ip, ix, vs
+    # python fallback
+    labels, ip, ix, vs = [], [0], [], []
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                k, _, v = tok.partition(":")
+                if v:
+                    ix.append(int(k))
+                    vs.append(float(v))
+            ip.append(len(ix))
+    return (onp.asarray(labels, "float32"), onp.asarray(ip, "int64"),
+            onp.asarray(ix, "int64"), onp.asarray(vs, "float32"))

@@ -41,6 +41,26 @@ def _ctx_of(jarr) -> Context:
     return current_context()
 
 
+# functions whose mx.np implementation is verified numpy-compatible —
+# the analog of the reference's explicit HANDLED registry
+# (numpy_dispatch_protocol.py _NUMPY_ARRAY_FUNCTION_LIST)
+_NP_DISPATCH_HANDLED = frozenset({
+    "concatenate", "stack", "vstack", "hstack", "dstack", "column_stack",
+    "split", "array_split", "mean", "sum", "prod", "std", "var", "median",
+    "max", "min", "amax", "amin", "argmax", "argmin", "clip", "reshape",
+    "transpose", "swapaxes", "moveaxis", "squeeze", "expand_dims",
+    "broadcast_to", "tile", "repeat", "flip", "roll", "rot90", "where",
+    "take", "dot", "matmul", "tensordot", "inner", "outer", "kron",
+    "trace", "diag", "diagonal", "tril", "triu", "sort", "argsort",
+    "cumsum", "cumprod", "einsum", "atleast_1d", "atleast_2d",
+    "atleast_3d", "ravel", "nansum", "nanmean", "nanmax", "nanmin",
+    "quantile", "percentile", "average", "cov", "corrcoef", "bincount",
+    "diff", "ediff1d", "interp", "meshgrid", "linspace", "logspace",
+    "pad", "searchsorted", "digitize", "histogram", "zeros_like",
+    "ones_like", "full_like",
+})
+
+
 class NDArray:
     __slots__ = ("_data", "_ag_info", "_grad", "_grad_req", "_dc_sym", "__weakref__")
 
@@ -171,6 +191,47 @@ class NDArray:
     def __array__(self, dtype=None):
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
+
+    # NumPy dispatch protocol (reference: python/mxnet/
+    # numpy_dispatch_protocol.py): onp.exp(x) / onp.concatenate([x, y])
+    # on framework arrays route to the registered TPU ops for the CURATED
+    # function list (semantics verified against numpy); anything outside
+    # the list falls back to host numpy over __array__ conversion — the
+    # pre-protocol behavior, so no previously-working call breaks.
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__" or kwargs.pop("out", None) is not None:
+            return self._host_fallback(getattr(ufunc, method, ufunc),
+                                       inputs, kwargs)
+        from .. import numpy as _mxnp
+
+        fn = getattr(_mxnp, ufunc.__name__, None)
+        if fn is not None:
+            try:
+                return fn(*inputs, **kwargs)
+            except TypeError:
+                pass
+        return self._host_fallback(ufunc, inputs, kwargs)
+
+    def __array_function__(self, func, types, args, kwargs):
+        from .. import numpy as _mxnp
+
+        if func.__name__ in _NP_DISPATCH_HANDLED:
+            fn = getattr(_mxnp, func.__name__, None)
+            if fn is not None:
+                return fn(*args, **kwargs)
+        return self._host_fallback(func, args, kwargs)
+
+    @staticmethod
+    def _host_fallback(func, args, kwargs):
+        def conv(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, (list, tuple)):
+                return type(x)(conv(v) for v in x)
+            return x
+
+        return func(*conv(list(args)),
+                    **{k: conv(v) for k, v in kwargs.items()})
 
     # ----------------------------------------------------------- conversion
     def astype(self, dtype, copy=True):

@@ -1,0 +1,264 @@
+// Native threaded text parsers: CSV (dense) and LibSVM (CSR).
+//
+// TPU-native analog of the reference's data-parsing path
+// (src/io/iter_csv.cc, src/io/iter_libsvm.cc over dmlc-core's
+// threaded_parser): the file is split at line boundaries into one chunk
+// per hardware thread, each chunk is tokenized with a hand-rolled float
+// scanner (no locale, no strtod overhead on the fast path), and results
+// are stitched in order. The Python side (mxnet_tpu/io) calls through
+// ctypes and keeps batches on host until the device step needs them —
+// one H2D per batch, never per sample.
+//
+// C ABI:
+//   tp_csv_parse(path, delim, &rows, &cols) -> float*  (row-major), or
+//     nullptr on error; caller frees with tp_free.
+//   tp_libsvm_parse(path, &nrows, &nnz, &indptr, &indices, &values,
+//     &labels) -> 0 on success; arrays freed with tp_free / tp_free_i64.
+//   tp_free / tp_free_i64: release buffers returned above.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// fast float scanner: [+-]?digits[.digits][eE[+-]digits] | nan | inf(inity)
+inline const char* scan_float(const char* p, const char* end, float* out) {
+  while (p < end && (*p == ' ' || *p == '\t')) ++p;
+  if (p >= end) return nullptr;
+  bool neg = false;
+  if (*p == '-' || *p == '+') { neg = (*p == '-'); ++p; }
+  if (p + 2 < end && (*p == 'n' || *p == 'N') &&
+      (p[1] == 'a' || p[1] == 'A') && (p[2] == 'n' || p[2] == 'N')) {
+    *out = std::nanf("");
+    return p + 3;
+  }
+  if (p + 2 < end && (*p == 'i' || *p == 'I') &&
+      (p[1] == 'n' || p[1] == 'N') && (p[2] == 'f' || p[2] == 'F')) {
+    p += 3;
+    // optional "inity" suffix
+    const char* suffix = "inity";
+    for (int k = 0; k < 5 && p < end; ++k) {
+      char c = *p | 0x20;
+      if (c != suffix[k]) break;
+      ++p;
+    }
+    *out = neg ? -HUGE_VALF : HUGE_VALF;
+    return p;
+  }
+  double v = 0.0;
+  bool any = false;
+  while (p < end && *p >= '0' && *p <= '9') {
+    v = v * 10.0 + (*p - '0'); ++p; any = true;
+  }
+  if (p < end && *p == '.') {
+    ++p;
+    double scale = 0.1;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v += (*p - '0') * scale; scale *= 0.1; ++p; any = true;
+    }
+  }
+  if (!any) return nullptr;
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    bool eneg = false;
+    if (p < end && (*p == '-' || *p == '+')) { eneg = (*p == '-'); ++p; }
+    int ex = 0;
+    while (p < end && *p >= '0' && *p <= '9') { ex = ex * 10 + (*p - '0'); ++p; }
+    double f = 1.0;
+    while (ex--) f *= 10.0;
+    v = eneg ? v / f : v * f;
+  }
+  *out = static_cast<float>(neg ? -v : v);
+  return p;
+}
+
+struct FileBuf {
+  char* data = nullptr;
+  size_t size = 0;
+  bool ok = false;
+  explicit FileBuf(const char* path) {
+    FILE* f = std::fopen(path, "rb");
+    if (!f) return;
+    std::fseek(f, 0, SEEK_END);
+    long n = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    if (n < 0) { std::fclose(f); return; }
+    data = static_cast<char*>(std::malloc(n + 1));
+    size = static_cast<size_t>(n);
+    ok = data && std::fread(data, 1, size, f) == size;
+    std::fclose(f);
+    if (data) data[size] = '\n';
+  }
+  ~FileBuf() { std::free(data); }
+};
+
+// split [0, size) into per-thread chunks ending on newline boundaries
+std::vector<std::pair<size_t, size_t>> chunks_of(const char* data,
+                                                 size_t size, int nthread) {
+  std::vector<std::pair<size_t, size_t>> out;
+  size_t begin = 0;
+  for (int t = 0; t < nthread && begin < size; ++t) {
+    size_t end = (t == nthread - 1) ? size
+                                    : begin + (size - begin) / (nthread - t);
+    while (end < size && data[end] != '\n') ++end;
+    if (end < size) ++end;  // include the newline
+    out.emplace_back(begin, end);
+    begin = end;
+  }
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+float* tp_csv_parse(const char* path, char delim, int64_t* rows,
+                    int64_t* cols) {
+  FileBuf fb(path);
+  if (!fb.ok) return nullptr;
+  int nthread = std::max(1u, std::thread::hardware_concurrency());
+  auto parts = chunks_of(fb.data, fb.size, nthread);
+
+  // pass 1 (first line): column count
+  int64_t ncol = 0;
+  {
+    const char* p = fb.data;
+    const char* end = fb.data + fb.size;
+    const char* nl = static_cast<const char*>(
+        memchr(p, '\n', fb.size));
+    if (!nl) nl = end;
+    float v;
+    while (p < nl) {
+      const char* q = scan_float(p, nl, &v);
+      if (!q) break;
+      ++ncol;
+      p = q;
+      while (p < nl && *p != delim) ++p;
+      if (p < nl) ++p;
+    }
+  }
+  if (ncol == 0) return nullptr;
+
+  // per-chunk parse into private vectors, then stitch. Malformed input
+  // (unparsable token, ragged row) fails the WHOLE parse — the caller
+  // falls back to the strict numpy path, matching its error behavior
+  // instead of silently zero-filling.
+  std::vector<std::vector<float>> results(parts.size());
+  std::vector<std::thread> pool;
+  std::vector<char> errs(parts.size(), 0);
+  for (size_t t = 0; t < parts.size(); ++t) {
+    pool.emplace_back([&, t]() {
+      const char* p = fb.data + parts[t].first;
+      const char* end = fb.data + parts[t].second;
+      auto& out = results[t];
+      out.reserve((parts[t].second - parts[t].first) / 4);
+      while (p < end) {
+        const char* nl = static_cast<const char*>(
+            memchr(p, '\n', end - p));
+        if (!nl) nl = end;
+        if (nl > p && !(nl == p + 1 && *p == '\r')) {  // skip empty lines
+          float v;
+          const char* q = p;
+          for (int64_t c = 0; c < ncol; ++c) {
+            const char* r = scan_float(q, nl, &v);
+            if (!r) { errs[t] = 1; return; }
+            out.push_back(v);
+            q = r;
+            while (q < nl && *q != delim && *q != '\r') ++q;
+            if (q < nl && *q == delim) ++q;
+          }
+          // a row with MORE fields than the header row is ragged too
+          while (q < nl && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+          if (q < nl) { errs[t] = 1; return; }
+        }
+        p = nl + 1;
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+  for (char e : errs)
+    if (e) return nullptr;
+
+  size_t total = 0;
+  for (auto& r : results) total += r.size();
+  float* out = static_cast<float*>(std::malloc(total * sizeof(float)));
+  if (!out) return nullptr;
+  size_t off = 0;
+  for (auto& r : results) {
+    std::memcpy(out + off, r.data(), r.size() * sizeof(float));
+    off += r.size();
+  }
+  *rows = static_cast<int64_t>(total / ncol);
+  *cols = ncol;
+  return out;
+}
+
+// LibSVM: "label idx:val idx:val ...\n" -> CSR (indptr, indices, values)
+int tp_libsvm_parse(const char* path, int64_t* nrows, int64_t* nnz,
+                    int64_t** indptr, int64_t** indices, float** values,
+                    float** labels) {
+  FileBuf fb(path);
+  if (!fb.ok) return -1;
+  std::vector<int64_t> ip{0};
+  std::vector<int64_t> ix;
+  std::vector<float> vals;
+  std::vector<float> labs;
+  const char* p = fb.data;
+  const char* end = fb.data + fb.size;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!nl) nl = end;
+    if (nl > p) {
+      float lab;
+      const char* q = scan_float(p, nl, &lab);
+      if (q) {
+        labs.push_back(lab);
+        while (q < nl) {
+          while (q < nl && *q == ' ') ++q;
+          // integer index scan — float would round indices >= 2^24
+          const char* r = q;
+          int64_t idx = 0;
+          bool any_digit = false;
+          while (r < nl && *r >= '0' && *r <= '9') {
+            idx = idx * 10 + (*r - '0'); ++r; any_digit = true;
+          }
+          if (!any_digit || r >= nl || *r != ':') break;
+          float v;
+          const char* s = scan_float(r + 1, nl, &v);
+          if (!s) break;
+          ix.push_back(idx);
+          vals.push_back(v);
+          q = s;
+        }
+        ip.push_back(static_cast<int64_t>(ix.size()));
+      }
+    }
+    p = nl + 1;
+  }
+  *nrows = static_cast<int64_t>(labs.size());
+  *nnz = static_cast<int64_t>(ix.size());
+  *indptr = static_cast<int64_t*>(std::malloc(ip.size() * sizeof(int64_t)));
+  *indices = static_cast<int64_t*>(std::malloc(
+      std::max<size_t>(1, ix.size()) * sizeof(int64_t)));
+  *values = static_cast<float*>(std::malloc(
+      std::max<size_t>(1, vals.size()) * sizeof(float)));
+  *labels = static_cast<float*>(std::malloc(
+      std::max<size_t>(1, labs.size()) * sizeof(float)));
+  if (!*indptr || !*indices || !*values || !*labels) return -1;
+  std::memcpy(*indptr, ip.data(), ip.size() * sizeof(int64_t));
+  std::memcpy(*indices, ix.data(), ix.size() * sizeof(int64_t));
+  std::memcpy(*values, vals.data(), vals.size() * sizeof(float));
+  std::memcpy(*labels, labs.data(), labs.size() * sizeof(float));
+  return 0;
+}
+
+void tp_free(float* p) { std::free(p); }
+void tp_free_i64(int64_t* p) { std::free(p); }
+
+}  // extern "C"

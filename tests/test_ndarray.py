@@ -6,6 +6,7 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import np
 from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ndarray.ndarray import NDArray
 from mxnet_tpu.test_utils import assert_almost_equal
 
 
@@ -204,3 +205,27 @@ def test_np_savez_roundtrip(tmp_path):
     z = onp.load(f)  # interchange with stock numpy
     assert z["b"].shape == (3,)
     z.close()
+
+
+def test_numpy_dispatch_protocol():
+    """onp ufuncs/functions on NDArray route to TPU ops (reference:
+    numpy_dispatch_protocol.py) instead of converting to host numpy."""
+    x = mx.np.array([1.0, 2.0, 3.0])
+    y = onp.exp(x)
+    assert isinstance(y, NDArray)
+    assert_almost_equal(y, onp.exp(onp.array([1.0, 2.0, 3.0])))
+    z = onp.add(x, onp.ones(3, "float32"))
+    assert isinstance(z, NDArray)
+    assert_almost_equal(z, [2.0, 3.0, 4.0])
+    c = onp.concatenate([x, x])
+    assert isinstance(c, NDArray) and c.shape == (6,)
+    m = onp.mean(x)
+    assert isinstance(m, NDArray) and float(m.asnumpy()) == 2.0
+    # functions outside the curated list keep working via host fallback
+    # (the pre-protocol __array__ behavior): result is a host array
+    g = onp.gradient(x)
+    assert isinstance(g, onp.ndarray)
+    assert_almost_equal(g, [1.0, 1.0, 1.0])
+    # ufunc methods (reduce etc.) also fall back to host
+    r = onp.add.reduce(x)
+    assert float(r) == 6.0
