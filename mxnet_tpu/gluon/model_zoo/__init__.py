@@ -5,6 +5,9 @@ from . import bert
 from .bert import bert_base, bert_large, BERTModel, BERTForPretraining
 from . import rnn_lm
 from .rnn_lm import RNNModel
+from . import gpt
+from .gpt import GPTModel, gpt2_small, gpt2_medium, gpt_tiny
 
 __all__ = ["vision", "get_model", "bert", "bert_base", "bert_large",
+           "gpt", "GPTModel", "gpt2_small", "gpt2_medium", "gpt_tiny",
            "BERTModel", "BERTForPretraining", "rnn_lm", "RNNModel"]

@@ -1,0 +1,155 @@
+"""GPT-style decoder-only causal language model (model-zoo LM family).
+
+Reference scope: the transformer-LM example family the reference ships
+(example/gluon/word_language_model + the transformer ops in
+src/operator/contrib/transformer.cc) — rebuilt as a pre-LN causal decoder,
+the architecture of GPT-2. TPU design notes:
+
+- attention runs through the causal flash-attention path
+  (ops/pallas_kernels.py) — O(T) memory, MXU-tiled;
+- the whole forward is one jit under hybridize: static shapes, no
+  KV-cache branching in the compiled graph;
+- ``generate`` feeds a fixed-width window (static shape ⇒ one compiled
+  program serves every step — the TPU answer to the reference's
+  dynamic-length incremental decode).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import initializer as init_mod
+from ... import numpy_extension as npx
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+
+__all__ = ["GPTModel", "gpt2_small", "gpt2_medium", "gpt_tiny"]
+
+
+class DecoderLayer(HybridBlock):
+    """Pre-LN causal transformer block (GPT-2 convention)."""
+
+    def __init__(self, units=768, hidden_size=3072, num_heads=12,
+                 dropout=0.1, layer_norm_eps=1e-5, dtype="float32",
+                 **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError("units must be divisible by num_heads")
+        self._num_heads = num_heads
+        self._dropout = dropout
+        self.ln_1 = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.attn_qkv = nn.Dense(3 * units, flatten=False, dtype=dtype,
+                                 weight_initializer=init_mod.Normal(0.02),
+                                 in_units=units)
+        self.attn_proj = nn.Dense(units, flatten=False, dtype=dtype,
+                                  weight_initializer=init_mod.Normal(0.02),
+                                  in_units=units)
+        self.ln_2 = nn.LayerNorm(epsilon=layer_norm_eps, in_channels=units)
+        self.ffn_1 = nn.Dense(hidden_size, flatten=False, dtype=dtype,
+                              weight_initializer=init_mod.Normal(0.02),
+                              in_units=units)
+        self.ffn_2 = nn.Dense(units, flatten=False, dtype=dtype,
+                              weight_initializer=init_mod.Normal(0.02),
+                              in_units=hidden_size)
+
+    def forward(self, x):
+        h = self.ln_1(x)
+        qkv = self.attn_qkv(h)
+        units = qkv.shape[-1] // 3
+        q = npx.slice_axis(qkv, axis=-1, begin=0, end=units)
+        k = npx.slice_axis(qkv, axis=-1, begin=units, end=2 * units)
+        v = npx.slice_axis(qkv, axis=-1, begin=2 * units, end=3 * units)
+        attn = npx.multihead_attention(q, k, v, num_heads=self._num_heads,
+                                       causal=True)
+        attn = self.attn_proj(attn)
+        if self._dropout:
+            attn = npx.dropout(attn, p=self._dropout)
+        x = x + attn
+        h = self.ln_2(x)
+        ffn = self.ffn_2(npx.leaky_relu(self.ffn_1(h), act_type="gelu"))
+        if self._dropout:
+            ffn = npx.dropout(ffn, p=self._dropout)
+        return x + ffn
+
+
+class GPTModel(HybridBlock):
+    """Token+position embeddings → N pre-LN causal blocks → tied LM head."""
+
+    def __init__(self, vocab_size=50257, num_layers=12, units=768,
+                 hidden_size=None, num_heads=12, max_length=1024,
+                 dropout=0.1, tie_weights=True, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        hidden_size = hidden_size or 4 * units
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self._tie = tie_weights
+        self.tok_embed = nn.Embedding(vocab_size, units, dtype=dtype)
+        self.pos_embed = nn.Embedding(max_length, units, dtype=dtype)
+        self.blocks = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.blocks.add(DecoderLayer(units, hidden_size, num_heads,
+                                         dropout, dtype=dtype))
+        self.ln_f = nn.LayerNorm(epsilon=1e-5, in_channels=units)
+        self._dropout = dropout
+        if not tie_weights:
+            self.lm_head = nn.Dense(vocab_size, flatten=False,
+                                    use_bias=False, dtype=dtype,
+                                    in_units=units)
+
+    def forward(self, tokens):
+        from ... import numpy as np
+
+        B, T = tokens.shape
+        pos = np.arange(T, dtype="int32").reshape(1, T)
+        x = self.tok_embed(tokens) + self.pos_embed(pos)
+        if self._dropout:
+            x = npx.dropout(x, p=self._dropout)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        if self._tie:
+            # weight tying (Press & Wolf): logits = x · E^T
+            return np.matmul(x, self.tok_embed.weight.data().T)
+        return self.lm_head(x)
+
+    def generate(self, prompt, max_new_tokens=20, temperature=0.0,
+                 window=None):
+        """Greedy / temperature sampling with a fixed-width rolling window
+        so the compiled forward is reused for every step."""
+        from ... import numpy as np
+        from ... import random as rnd
+
+        window = window or min(self.max_length, 64)
+        toks = list(onp.asarray(prompt.asnumpy(), dtype="int64").ravel())
+        for _ in range(max_new_tokens):
+            ctx_toks = toks[-window:]
+            pad = window - len(ctx_toks)
+            inp = onp.asarray([[0] * pad + ctx_toks], dtype="int32")
+            logits = self(np.array(inp))[0, -1]
+            if temperature > 0:
+                probs = npx.softmax(logits / temperature, axis=-1)
+                nxt = int(rnd.categorical(np.log(
+                    np.maximum(probs, 1e-20))).asnumpy())
+            else:
+                nxt = int(logits.asnumpy().argmax())
+            toks.append(nxt)
+        return toks
+
+
+def gpt_tiny(vocab_size=1000, **kwargs):
+    """Test/edge configuration."""
+    kwargs.setdefault("num_layers", 2)
+    kwargs.setdefault("units", 64)
+    kwargs.setdefault("num_heads", 4)
+    kwargs.setdefault("max_length", 128)
+    return GPTModel(vocab_size=vocab_size, **kwargs)
+
+
+def gpt2_small(vocab_size=50257, **kwargs):
+    return GPTModel(vocab_size=vocab_size, num_layers=12, units=768,
+                    num_heads=12, **kwargs)
+
+
+def gpt2_medium(vocab_size=50257, **kwargs):
+    return GPTModel(vocab_size=vocab_size, num_layers=24, units=1024,
+                    num_heads=16, **kwargs)

@@ -73,16 +73,56 @@ def _shape(size):
     return tuple(size)
 
 
+def _unwrap(p):
+    from .ndarray.ndarray import NDArray
+
+    return p._data if isinstance(p, NDArray) else p
+
+
+def _via_op(op_name, ctx=None, out=None, **attrs):
+    """Draw through the registered sampler op (ops/random_ops.py).
+
+    Going through invoke() is what makes sampling *traceable*: under
+    HybridBlock deferred compute the op is recorded with a fresh-per-call
+    PRNG-key input, so a compiled graph redraws on every replay instead of
+    baking the traced constant (reference analog: sample ops recorded as
+    graph nodes, resource_manager kRandom).
+    """
+    from .ops.registry import apply_op
+
+    arr = apply_op(op_name, **attrs)
+    if ctx is not None:
+        arr = arr.as_in_ctx(ctx)
+    if out is not None:
+        out._set_data(arr._data)
+        return out
+    return arr
+
+
 def uniform(low=0.0, high=1.0, size=None, dtype="float32", ctx=None,
             device=None, out=None):
-    data = jax.random.uniform(_next_key(), _shape(size), dtype=_f(dtype),
+    if not (hasattr(low, "shape") or hasattr(high, "shape")):
+        return _via_op("_npi_uniform", ctx=device or ctx, out=out,
+                       low=low, high=high, size=_shape(size),
+                       dtype=str(dtype))
+    low, high = _unwrap(low), _unwrap(high)
+    shape = _shape(size) or jax.numpy.broadcast_shapes(
+        jax.numpy.shape(low), jax.numpy.shape(high))
+    data = jax.random.uniform(_next_key(), shape, dtype=_f(dtype),
                               minval=low, maxval=high)
     return _wrap(data, device or ctx, out)
 
 
 def normal(loc=0.0, scale=1.0, size=None, dtype="float32", ctx=None,
            device=None, out=None):
-    data = jax.random.normal(_next_key(), _shape(size), dtype=_f(dtype))
+    if not (hasattr(loc, "shape") or hasattr(scale, "shape")):
+        return _via_op("_npi_normal", ctx=device or ctx, out=out,
+                       loc=loc, scale=scale, size=_shape(size),
+                       dtype=str(dtype))
+    loc, scale = _unwrap(loc), _unwrap(scale)
+    shape = _shape(size) or jax.numpy.broadcast_shapes(
+        jax.numpy.shape(loc), jax.numpy.shape(scale))
+    data = jax.random.normal(_next_key(), shape, dtype=_f(dtype))
     return _wrap(data * scale + loc, device or ctx, out)
 
 
@@ -107,11 +147,15 @@ def randint(low, high=None, size=None, dtype="int32", ctx=None, device=None,
     if high is None:
         low, high = 0, low
     dt = "int32" if str(dtype) in ("int64", "int32", "int") else str(dtype)
-    data = jax.random.randint(_next_key(), _shape(size), low, high, dtype=dt)
-    return _wrap(data, device or ctx, out)
+    return _via_op("_random_randint", ctx=device or ctx, out=out,
+                   low=int(low), high=int(high), shape=_shape(size),
+                   dtype=dt)
 
 
 def bernoulli(prob=0.5, size=None, dtype="float32", ctx=None):
+    if not hasattr(prob, "shape"):
+        return _via_op("_npi_bernoulli", ctx=ctx, prob=prob,
+                       size=_shape(size), dtype=str(dtype))
     data = jax.random.bernoulli(_next_key(), prob, _shape(size))
     return _wrap(data.astype(_f(dtype) if "float" in str(dtype) else dtype), ctx)
 
@@ -163,11 +207,39 @@ def categorical(logits, size=None, ctx=None):
     return _wrap(jax.random.categorical(_next_key(), lg, shape=shape), ctx)
 
 
+# scalar-parameter draws route through the registered sampler op (traceable;
+# see _via_op); tensor parameters keep the direct jax.random path
+_OP_ROUTE = {
+    "exponential": lambda p, kw: ("_npi_exponential",
+                                  {"scale": p[0] if p else 1.0}),
+    "gamma": lambda p, kw: ("_npi_gamma",
+                            {"shape": p[0] if p else 1.0,
+                             "scale": p[1] if len(p) > 1 else 1.0}),
+    "laplace": lambda p, kw: ("_npi_laplace",
+                              {"loc": p[0] if p else 0.0,
+                               "scale": p[1] if len(p) > 1 else 1.0}),
+    "gumbel": lambda p, kw: ("_npi_gumbel",
+                             {"loc": p[0] if p else 0.0,
+                              "scale": p[1] if len(p) > 1 else 1.0}),
+    "logistic": lambda p, kw: ("_npi_logistic",
+                               {"loc": p[0] if p else 0.0,
+                                "scale": p[1] if len(p) > 1 else 1.0}),
+    "rayleigh": lambda p, kw: ("_npi_rayleigh",
+                               {"scale": p[0] if p else 1.0}),
+    "weibull": lambda p, kw: ("_npi_weibull", {"a": p[0] if p else 1.0}),
+}
+
+
 def _simple(fn_name):
     def sampler(*params, size=None, dtype="float32", ctx=None, out=None, **kw):
         import jax.numpy as jnp
         from .ndarray.ndarray import NDArray
 
+        if fn_name in _OP_ROUTE and not any(
+                hasattr(p, "shape") for p in params):
+            op_name, attrs = _OP_ROUTE[fn_name](params, kw)
+            return _via_op(op_name, ctx=ctx, out=out, size=_shape(size),
+                           dtype=str(dtype), **attrs)
         params = tuple(p._data if isinstance(p, NDArray) else p for p in params)
         fn = getattr(jax.random, fn_name)
         shape = _shape(size)
