@@ -199,7 +199,44 @@ class NDArray:
     # the list falls back to host numpy over __array__ conversion — the
     # pre-protocol behavior, so no previously-working call breaks.
     def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
-        if method != "__call__" or kwargs.pop("out", None) is not None:
+        out = kwargs.pop("out", None)
+        if out is not None:
+            import jax.numpy as jnp
+
+            # honor numpy's in-place `out=` contract: run on host into
+            # plain buffers, then write results back into NDArray outs
+            outs = out if isinstance(out, tuple) else (out,)
+            host_outs = tuple(
+                onp.array(o.asnumpy()) if isinstance(o, NDArray) else o
+                for o in outs)  # asnumpy() can be a read-only device view
+            res = self._host_fallback(getattr(ufunc, method, ufunc),
+                                      inputs, {**kwargs, "out": host_outs})
+            res_items = res if isinstance(res, tuple) else (res,)
+            filled = []
+            for o, h, r in zip(outs, host_outs, res_items):
+                if isinstance(o, NDArray):
+                    o._set_data(jnp.asarray(h))
+                    filled.append(o)
+                else:
+                    # None slots: numpy allocated the result itself
+                    filled.append(r if o is None else o)
+            return filled[0] if len(filled) == 1 else tuple(filled)
+        if method == "at":
+            # in-place scatter contract (onp.add.at(x, idx, v)): mutate a
+            # writable host copy, then write it back into the NDArray —
+            # _host_fallback alone would mutate a throwaway copy
+            target = inputs[0]
+            if isinstance(target, NDArray):
+                import jax.numpy as jnp
+
+                host = onp.array(target.asnumpy())
+                self._host_fallback(getattr(ufunc, method),
+                                    (host,) + inputs[1:], kwargs)
+                target._set_data(jnp.asarray(host))
+                return None
+            return self._host_fallback(getattr(ufunc, method), inputs,
+                                       kwargs)
+        if method != "__call__":
             return self._host_fallback(getattr(ufunc, method, ufunc),
                                        inputs, kwargs)
         from .. import numpy as _mxnp

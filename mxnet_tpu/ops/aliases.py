@@ -45,6 +45,9 @@ _LEGACY_CAMEL = {
 _LEGACY_UNDER = {
     "_copy": "copy",
     "_copyto": "copy",
+    # elemwise_unary_op_basic.cc:245 — bare `identity` is an alias of _copy
+    # in the reference; the matrix creator lives at _npi_identity only
+    "identity": "copy",
     "_equal": "equal",
     "_not_equal": "not_equal",
     "_greater": "greater",

@@ -240,9 +240,20 @@ register("eye", lambda N=1, M=None, k=0, dtype="float32", ctx=None, **a:
          (lambda: jnp.eye(int(N), M if M is None else int(M), k=int(k),
                           dtype=dtype or "float32")),
          differentiable=False)
-register("identity", lambda n=1, dtype="float32", ctx=None, **a:
-         (lambda: jnp.identity(int(n), dtype=dtype or "float32")),
-         differentiable=False)
+# NB: the bare op name `identity` is an alias of `copy` in the reference
+# (elemwise_unary_op_basic.cc:245 — elementwise identity over one input);
+# only the numpy-namespace `_npi_identity` is the zero-input matrix creator
+# (np_init_op.cc). Registering the creator under the bare name would break
+# legacy nd.identity(x) callers.
+def _make_npi_identity(shape=None, n=None, dtype="float32", ctx=None, **a):
+    # reference frontend passes shape=(n, n) (np_init_op.cc IdentityParam);
+    # n= kept as a convenience spelling
+    if n is None:
+        n = shape[0] if shape else 1
+    return lambda: jnp.identity(int(n), dtype=dtype or "float32")
+
+
+register("_npi_identity", _make_npi_identity, differentiable=False)
 def _make_arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32",
                  ctx=None, infer_range=False, **a):
     # legacy contract (init_op.cc RangeParam): arange(N) means [0, N)
@@ -279,7 +290,7 @@ for _alias, _tgt in {
     "_linspace": "linspace",
     "_npi_zeros": "zeros", "_npi_ones": "ones", "_npi_full": "full",
     "_npi_full_like": "full_like", "_npi_eye": "eye",
-    "_npi_identity": "identity", "_npi_arange": "arange",
+    "_npi_arange": "arange",
     "_npi_linspace": "linspace", "_npi_logspace": "logspace",
     "_npi_tri": "tri", "_npi_indices": "indices",
 }.items():
@@ -414,12 +425,19 @@ register("Reshape", lambda shape=(), reverse=False, **a:
              x, _legacy_reshape_shape(x.shape, shape, reverse))))
 
 
-def _npx_reshape_shape(src, spec):
-    """NumpyXReshape (np_matrix_op.cc): -2 copy dim, -3 skip (merge into
-    neighbor? no: -3 means merge two consecutive), -4 split with trailing
-    dims, -5 merge two consecutive into one, -6 split into two."""
-    out, i = [], 0
+def _npx_reshape_shape(src, spec, reverse=False):
+    """NumpyXReshape shape codes (np_matrix_op.cc NumpyXReshapeInferShape:202):
+    -1 infer one dim, -2 copy the next src dim, -3 skip a size-1 src dim
+    (emits nothing), -4 copy ALL remaining src dims, -5 merge two consecutive
+    src dims, -6 split one src dim into the two following spec values (one of
+    which may be -1). ``reverse=True`` applies the spec right-to-left
+    (np_matrix_op.cc:348-354: reverse src and spec, infer, reverse output)."""
+    src = list(src)
     spec = list(spec)
+    if reverse:
+        return tuple(reversed(
+            _npx_reshape_shape(src[::-1], spec[::-1], reverse=False)))
+    out, i = [], 0
     j = 0
     while j < len(spec):
         c = spec[j]
@@ -428,18 +446,23 @@ def _npx_reshape_shape(src, spec):
         elif c == -1:
             out.append(-1); i += 1
         elif c == -3:
+            if src[i] != 1:
+                raise ValueError(
+                    "-3 reshape code may only skip a size-1 dimension, "
+                    f"got {src[i]} at axis {i}")
+            i += 1  # emit nothing
+        elif c == -4:
             out.extend(src[i:]); i = len(src)
         elif c == -5:
             out.append(src[i] * src[i + 1]); i += 2
         elif c == -6:
+            d0 = src[i]
             d1, d2 = spec[j + 1], spec[j + 2]
             if d1 == -1:
-                d1 = src[i] // d2
+                d1 = d0 // d2
             if d2 == -1:
-                d2 = src[i] // d1
+                d2 = d0 // d1
             out.extend([d1, d2]); i += 1; j += 2
-        elif c == 0:
-            out.append(0); i += 1
         else:
             out.append(c); i += 1
         j += 1
@@ -447,7 +470,8 @@ def _npx_reshape_shape(src, spec):
 
 
 register("_npx_reshape", lambda newshape=(), reverse=False, **a:
-         (lambda x: jnp.reshape(x, _npx_reshape_shape(x.shape, newshape))))
+         (lambda x: jnp.reshape(
+             x, _npx_reshape_shape(x.shape, newshape, reverse))))
 
 register("SliceChannel", lambda num_outputs=1, axis=1, squeeze_axis=False, **a:
          (lambda x: tuple(

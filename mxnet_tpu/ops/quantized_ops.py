@@ -137,11 +137,14 @@ register_alias("_contrib_quantized_concat", "quantized_concat")
 def _quantized_elemwise_add(**a):
     def f(qa, qb, mna, mxa, mnb, mxb):
         sa, sb = _scale(mna, mxa), _scale(mnb, mxb)
-        acc = qa.astype(jnp.int32) * jnp.round(sa * 2 ** 16).astype(
-            jnp.int32) + qb.astype(jnp.int32) * jnp.round(
-            sb * 2 ** 16).astype(jnp.int32)
-        # report the exact representable range of the int32 accumulator
-        s_out = 1.0 / 2 ** 16
+        # sum in float32 (exact for int8-scaled values), then emit the
+        # int32 code against a shared output scale. The previous
+        # fixed-point route round(s*2^16) underflowed to 0 for ranges
+        # below ~1e-3, silently dropping that operand from the sum.
+        fsum = qa.astype(jnp.float32) * sa + qb.astype(jnp.float32) * sb
+        s_out = jnp.maximum(sa, sb) * 2.0 / (2.0 ** 23)
+        acc = jnp.clip(jnp.round(fsum / s_out),
+                       -(2 ** 31 - 1), 2 ** 31 - 1).astype(jnp.int32)
         mx = jnp.float32(2 ** 31 - 1) * s_out
         return acc, -mx, mx
 

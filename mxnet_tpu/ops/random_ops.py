@@ -186,7 +186,39 @@ def _make_sample_multinomial(shape=(), get_prob=False, dtype="int32", **a):
 
 register("_sample_multinomial", _make_sample_multinomial,
          needs_rng=True, differentiable=False, nout=1)
-register_alias("_npi_multinomial", "_sample_multinomial")
+
+
+def categorical_counts(key, pv, n, shape):
+    """Draw ``n`` categorical samples from 1-D probabilities ``pv`` and
+    return per-category counts, shape ``shape + (len(pv),)``. Shared by the
+    ``_npi_multinomial`` op and ``mx.random.multinomial``. Counts are int32
+    (int64 would be silently truncated under JAX's default x64-off config).
+    Uses bincount per draw row, so peak memory is O(size*n + size*ncat) —
+    no one-hot (size, n, ncat) intermediate."""
+    ncat = pv.shape[-1]
+    draws = jax.random.categorical(
+        key, jnp.log(jnp.clip(pv, 1e-30, None)), shape=tuple(shape) + (n,))
+    flat = draws.reshape(-1, n)
+    cnt = jax.vmap(lambda d: jnp.bincount(d, length=ncat))(flat)
+    return cnt.reshape(tuple(shape) + (ncat,)).astype(jnp.int32)
+
+
+def _make_npi_multinomial(n=1, pvals=None, size=None, **a):
+    """numpy.random.multinomial (np_multinomial_op.cc): draw ``n`` samples
+    from one categorical distribution and return per-category counts with
+    shape ``size + (num_categories,)``. Distinct from the legacy
+    ``_sample_multinomial`` index sampler (multisample_op.cc), which draws
+    categorical *indices* per probability row."""
+    s = _shp(size)
+    n = int(n)
+    if pvals is not None:
+        attr_pvals = jnp.asarray(pvals, jnp.float32)
+        return lambda key: categorical_counts(key, attr_pvals, n, s)
+    return lambda key, pv: categorical_counts(key, pv, n, s)
+
+
+register("_npi_multinomial", _make_npi_multinomial,
+         needs_rng=True, differentiable=False, nout=1)
 
 register("_shuffle", lambda **a:
          (lambda key, x: jax.random.permutation(key, x)),

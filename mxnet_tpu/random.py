@@ -190,12 +190,10 @@ def shuffle(x):
 def multinomial(n=1, pvals=None, size=None, ctx=None):
     import jax.numpy as jnp
     from .ndarray.ndarray import NDArray
+    from .ops.random_ops import categorical_counts
 
     pv = pvals._data if isinstance(pvals, NDArray) else jnp.asarray(pvals)
-    draws = jax.random.categorical(
-        _next_key(), jnp.log(pv), shape=_shape(size) + (n,))
-    counts = jax.nn.one_hot(draws, pv.shape[-1], dtype=jnp.int32).sum(-2)
-    return _wrap(counts, ctx)
+    return _wrap(categorical_counts(_next_key(), pv, n, _shape(size)), ctx)
 
 
 def categorical(logits, size=None, ctx=None):

@@ -229,3 +229,27 @@ def test_numpy_dispatch_protocol():
     # ufunc methods (reduce etc.) also fall back to host
     r = onp.add.reduce(x)
     assert float(r) == 6.0
+    # out= must actually write into the NDArray (advisor round 2: the out
+    # kwarg was popped and the result silently discarded)
+    z2 = mx.np.zeros(3)
+    ret = onp.add(x, x, out=z2)
+    assert_almost_equal(z2, [2.0, 4.0, 6.0])
+    assert ret is z2
+    # tuple-out ufuncs write every slot
+    q, rem = mx.np.zeros(3), mx.np.zeros(3)
+    onp.divmod(x, mx.np.array([2.0, 2.0, 2.0]), out=(q, rem))
+    assert_almost_equal(q, [0.0, 1.0, 1.0])
+    assert_almost_equal(rem, [1.0, 0.0, 1.0])
+
+
+def test_ufunc_at_and_npi_identity_shape():
+    """onp.add.at must mutate the NDArray in place; _npi_identity must
+    honor the reference shape= attr (np_init_op.cc)."""
+    from mxnet_tpu.ops.registry import apply_op
+
+    x = mx.np.array([1.0, 2.0, 3.0])
+    onp.add.at(x, onp.array([0, 2]), 10.0)
+    assert_almost_equal(x, [11.0, 2.0, 13.0])
+    eye3 = apply_op("_npi_identity", shape=(3, 3))
+    assert eye3.shape == (3, 3)
+    assert_almost_equal(eye3, onp.identity(3, "float32"))

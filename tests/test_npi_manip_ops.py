@@ -239,3 +239,32 @@ def test_custom_op_via_registry_name():
     out = apply_op("Custom", _nd(onp.array([1.0, 2.0], "float32")),
                    op_type=name)
     assert out.asnumpy().tolist() == [2.0, 4.0]
+
+
+def test_npx_reshape_shape_codes():
+    """NumpyXReshape codes (np_matrix_op.cc NumpyXReshapeInferShape:202):
+    -3 skips a size-1 dim, -4 copies all remaining dims, reverse applies
+    the spec right-to-left."""
+    x = _nd(onp.arange(24, dtype="float32").reshape(2, 1, 3, 4))
+    # -3: skip the size-1 axis entirely
+    out = apply_op("_npx_reshape", x, newshape=(-2, -3, -2, -2))
+    assert out.shape == (2, 3, 4)
+    # -4: copy all remaining dims
+    out = apply_op("_npx_reshape", x, newshape=(-2, -4))
+    assert out.shape == (2, 1, 3, 4)
+    out = apply_op("_npx_reshape", x, newshape=(2, -4))
+    assert out.shape == (2, 1, 3, 4)
+    # -5: merge two consecutive dims
+    out = apply_op("_npx_reshape", x, newshape=(-5, -5))
+    assert out.shape == (2, 12)
+    # -6: split a dim, with inference on one side
+    out = apply_op("_npx_reshape", x, newshape=(-2, -2, -2, -6, 2, -1))
+    assert out.shape == (2, 1, 3, 2, 2)
+    # reverse: spec consumed right-to-left (reference :348-354)
+    y = _nd(onp.arange(40, dtype="float32").reshape(8, 5))
+    out = apply_op("_npx_reshape", y, newshape=(-1, 4), reverse=True)
+    assert out.shape == (10, 4)
+    # -3 on a non-unit dim must raise
+    import pytest
+    with pytest.raises(Exception):
+        apply_op("_npx_reshape", x, newshape=(-3, -4))

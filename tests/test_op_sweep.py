@@ -715,8 +715,12 @@ SPECS.update({
                   lambda x: onp.full_like(x, 2.5), False),
     "eye": (lambda: [], {"N": 3, "k": 1},
             lambda: onp.eye(3, k=1, dtype="float32"), False),
-    "identity": (lambda: [], {"n": 3},
-                 lambda: onp.identity(3, "float32"), False),
+    # bare `identity` is an alias of `copy` (elemwise_unary_op_basic.cc:245);
+    # the matrix creator lives only at _npi_identity (np_init_op.cc)
+    "identity": (lambda: [_f(2, 3)], {},
+                 lambda x: x, False),
+    "_npi_identity": (lambda: [], {"n": 3},
+                      lambda: onp.identity(3, "float32"), False),
     "arange": (lambda: [], {"start": 2, "stop": 8, "step": 2,
                             "dtype": "float32"},
                lambda: onp.arange(2, 8, 2, "float32"), False),
@@ -1044,6 +1048,16 @@ def test_sampler_rowwise_and_choice():
     s = apply_op("_shuffle",
                  NDArray(onp.arange(8, dtype="float32"))).asnumpy()
     assert sorted(s.tolist()) == list(range(8))
+    # numpy multinomial: per-category COUNTS, shape size+(ncat,), sums to n
+    cnt = apply_op("_npi_multinomial",
+                   NDArray(onp.array([0.2, 0.8], dtype="float32")),
+                   n=100, size=(50,)).asnumpy()
+    assert cnt.shape == (50, 2)
+    assert (cnt.sum(axis=-1) == 100).all()
+    assert abs(cnt[:, 1].mean() - 80.0) < 5.0
+    cnt2 = apply_op("_npi_multinomial", n=10,
+                    pvals=(0.5, 0.5)).asnumpy()
+    assert cnt2.shape == (2,) and cnt2.sum() == 10
 
 
 _SAMPLER_COVERED = set(_SAMPLER_SPECS) | {
@@ -1051,6 +1065,7 @@ _SAMPLER_COVERED = set(_SAMPLER_SPECS) | {
     "_sample_normal", "_sample_gamma", "_sample_exponential",
     "_sample_poisson", "_sample_negative_binomial",
     "_sample_generalized_negative_binomial", "_sample_multinomial",
+    "_npi_multinomial",
     "_npi_choice", "_npi_normal_n", "_npi_uniform_n", "_shuffle",
 }
 

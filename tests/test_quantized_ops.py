@@ -98,7 +98,16 @@ def test_quantized_elemwise_and_embedding():
     s_out = max(abs(mmn.item()), abs(mmx.item())) / (2 ** 31 - 1)
     assert_almost_equal(m.asnumpy() * s_out, x * x, rtol=0.05, atol=0.05)
     a, amn, amx = apply_op("quantized_elemwise_add", q, q, mn, mx, mn, mx)
-    assert_almost_equal(a.asnumpy() / 2 ** 16, 2 * x, rtol=0.05, atol=0.05)
+    sa_out = max(abs(amn.item()), abs(amx.item())) / (2 ** 31 - 1)
+    assert_almost_equal(a.asnumpy() * sa_out, 2 * x, rtol=0.05, atol=0.05)
+    # regression (advisor round 2): tiny input ranges must not underflow
+    tiny = x * 1e-5
+    qt, tmn, tmx = apply_op("quantize_v2", _nd(tiny))
+    t, tamn, tamx = apply_op("quantized_elemwise_add", qt, qt, tmn, tmx,
+                             tmn, tmx)
+    st_out = max(abs(tamn.item()), abs(tamx.item())) / (2 ** 31 - 1)
+    assert_almost_equal(t.asnumpy() * st_out, 2 * tiny,
+                        rtol=0.05, atol=1e-6)
     w = RS.randn(10, 4).astype("float32")
     qw, wmn, wmx = apply_op("quantize_v2", _nd(w))
     e, _, _ = apply_op("quantized_embedding", _nd(onp.array([1, 3])), qw,
