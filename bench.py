@@ -171,6 +171,16 @@ def bench_bert_pretrain(size="base"):
             "mfu": _mfu(tok_s * 6 * BERT_PARAMS[size])}
 
 
+def _accel_expected():
+    """True when this machine is configured for an accelerator: either
+    JAX_PLATFORMS names a non-CPU platform, or a PJRT plugin site hook is
+    installed (the axon tunnel registers itself in every process)."""
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if any(p.strip() not in ("", "cpu") for p in plats.split(",")):
+        return True
+    return any("axon" in p for p in os.environ.get("PYTHONPATH", "").split(":"))
+
+
 def main():
     which = (sys.argv[1] if len(sys.argv) > 1 else
              os.environ.get("BENCH", "resnet"))
@@ -185,15 +195,32 @@ def main():
               "bert_large_pretrain": functools.partial(bench_bert_pretrain,
                                                        "large")}[which]
         # resolve the backend up front through the hardened probe: a hung
-        # or dead TPU runtime degrades to CPU instead of killing the bench
-        # (round-1 failure: raw RuntimeError from jax.default_backend()).
-        from mxnet_tpu.context import default_backend
+        # or dead TPU runtime must not kill the bench (round-1 failure:
+        # raw RuntimeError) — and must not silently publish a CPU number
+        # either (round-2 failure: 10 img/s recorded as if it were the
+        # result). The bench can afford one generous init: default the
+        # probe budget to 600 s here unless the operator set one.
+        os.environ.setdefault("MXTPU_BACKEND_PROBE_TIMEOUT_S", "600")
+        from mxnet_tpu.context import default_backend, \
+            last_backend_probe_error
 
-        result["backend"] = default_backend()
+        backend = default_backend()
+        result["backend"] = backend
         result["device"] = _device_info()[0]
-        result.update(fn())
+        if backend == "cpu" and _accel_expected() \
+                and os.environ.get("BENCH_ALLOW_CPU", "") != "1":
+            # TPU expected but unreachable: this is a failure to diagnose.
+            # Emit the verbatim plugin error / hang stack instead of
+            # spending minutes measuring the host (set BENCH_ALLOW_CPU=1
+            # to force a CPU measurement anyway).
+            err = last_backend_probe_error() or \
+                "accelerator expected but backend resolved to cpu " \
+                "(no probe diagnostic captured)"
+            result["error"] = "TPU unreachable: " + err[:3500]
+        else:
+            result.update(fn())
     except BaseException as e:  # noqa: BLE001 — always emit the JSON line
-        result["error"] = f"{type(e).__name__}: {e}"[:500]
+        result["error"] = f"{type(e).__name__}: {e}"[:3500]
     print(json.dumps(result))
     sys.stdout.flush()
     if "error" in result:

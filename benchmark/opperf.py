@@ -77,12 +77,47 @@ def bench_op(name, args, attrs, warmup=3, iters=20):
     return (time.perf_counter() - t0) / iters
 
 
+def bench_eager_vs_hybrid(n, warmup=3, iters=20):
+    """The dispatch-cost story (reference built a packed-func FFI because
+    this number matters: benchmark/python/ffi/): one forward of a small
+    MLP as (a) per-op eager dispatch and (b) one whole-graph CachedOp.
+    The ratio is the per-op overhead the hybridized path amortizes."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import np
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    for _ in range(4):
+        net.add(nn.Dense(n, activation="relu", in_units=n))
+    net.initialize()
+    x = np.array(onp.random.uniform(-1, 1, (32, n)).astype("float32"))
+
+    def timed(fn):
+        for _ in range(warmup):
+            out = fn(x)
+        sync(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(x)
+        sync(out)
+        return (time.perf_counter() - t0) / iters
+
+    eager_ms = timed(net) * 1e3
+    net.hybridize()
+    hybrid_ms = timed(net) * 1e3
+    return {"workload": f"mlp4x{n}_batch32", "eager_ms": round(eager_ms, 4),
+            "hybridized_ms": round(hybrid_ms, 4),
+            "eager_over_hybrid": round(eager_ms / hybrid_ms, 2)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", default=None,
                     help="comma-separated subset (default: all specs)")
     ap.add_argument("--size", type=int, default=1024)
     ap.add_argument("--table", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="also write the full result set to this JSON file")
     args = ap.parse_args()
 
     from mxnet_tpu.context import default_backend
@@ -103,13 +138,21 @@ def main():
         results.append({"op": name, "avg_time_ms": round(dt * 1e3, 4),
                         "backend": default_backend(),
                         "size": args.size})
+    compare = bench_eager_vs_hybrid(min(args.size, 512))
+    compare["backend"] = default_backend()
     if args.table:
         print(f"{'op':<20}{'avg ms':>12}")
         for r in results:
             print(f"{r['op']:<20}{r['avg_time_ms']:>12.4f}")
+        print(json.dumps(compare))
     else:
         for r in results:
             print(json.dumps(r))
+        print(json.dumps(compare))
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump({"per_op": results, "eager_vs_hybrid": compare},
+                      fh, indent=1)
 
 
 if __name__ == "__main__":

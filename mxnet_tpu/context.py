@@ -149,7 +149,15 @@ def device(dev: str | Context | None = None, device_id: int = 0) -> Context:
     raise MXNetError(f"cannot interpret {dev!r} as a device")
 
 
-_probe_cache = {"backend": None}
+_probe_cache = {"backend": None, "error": None}
+
+
+def last_backend_probe_error() -> str | None:
+    """The verbatim plugin error / hang stack from the most recent failed
+    backend probe (None after a successful probe). The bench embeds this in
+    its JSON artifact so an unreachable TPU is a diagnosable failure, not a
+    silent CPU fallback."""
+    return _probe_cache.get("error")
 
 
 def _subprocess_backend_probe(timeout_s: float) -> tuple[str | None, bool]:
@@ -161,26 +169,67 @@ def _subprocess_backend_probe(timeout_s: float) -> tuple[str | None, bool]:
     runtime — once ``xla_bridge.backends()`` has started in-process there
     is no clean way to abort it.
 
+    The child runs under a faulthandler deadline: on a hang it dumps the
+    stack of the blocked init (typically ``make_c_api_client`` — the PJRT
+    plugin dial-out) and exits, so the parent learns WHERE it hung, not
+    just that it hung. The last plugin error / hang stack is kept in
+    ``_probe_cache["error"]`` for diagnostics (the bench embeds it in its
+    JSON artifact rather than silently publishing a CPU number).
+
     Returns ``(backend_name_or_None, timed_out)``.
     """
     import subprocess
     import sys
 
-    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    # deadline inside the child (exit=True force-exits after the dump) so
+    # the stderr tail always contains the hang site; parent timeout is a
+    # backstop slightly above it
+    child_deadline = max(timeout_s - 2.0, 1.0)
+    code = (
+        "import faulthandler, sys\n"
+        f"faulthandler.dump_traceback_later({child_deadline!r}, exit=True,"
+        " file=sys.stderr)\n"
+        "import jax\n"
+        "try:\n"
+        "    b = jax.default_backend()\n"
+        "except BaseException as e:\n"
+        "    print('PROBE_ERROR=' + repr(e), flush=True)\n"
+        "    raise\n"
+        "print('BACKEND=' + b, flush=True)\n"
+    )
     try:
         out = subprocess.run(
             [sys.executable, "-c", code],
-            capture_output=True, text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
+            capture_output=True, text=True, timeout=timeout_s + 15.0)
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or b"")
+        if isinstance(tail, bytes):
+            tail = tail.decode("utf-8", "replace")
+        _probe_cache["error"] = ("backend probe timed out after "
+                                 f"{timeout_s:.0f}s; stderr tail:\n"
+                                 + tail[-2000:])
         return None, True
-    except OSError:
-        return None, False
-    if out.returncode != 0:
+    except OSError as e:
+        _probe_cache["error"] = f"backend probe could not launch: {e!r}"
         return None, False
     for line in reversed(out.stdout.strip().splitlines()):
         if line.startswith("BACKEND="):
-            return line[len("BACKEND="):], False
-    return None, False
+            if out.returncode == 0:
+                _probe_cache["error"] = None
+                return line[len("BACKEND="):], False
+        if line.startswith("PROBE_ERROR="):
+            _probe_cache["error"] = (line[len("PROBE_ERROR="):]
+                                     + "\nstderr tail:\n"
+                                     + (out.stderr or "")[-2000:])
+            return None, False
+    timed_out = "dump_traceback_later" in (out.stderr or "") or \
+        "Timeout" in (out.stderr or "")
+    _probe_cache["error"] = (
+        f"backend probe exited rc={out.returncode}"
+        + (" after in-child deadline (hung init; stack below)"
+           if timed_out else "")
+        + "; stderr tail:\n" + (out.stderr or "")[-2000:])
+    return None, timed_out
 
 
 def _probe_marker_path():
