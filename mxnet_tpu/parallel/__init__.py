@@ -19,10 +19,13 @@ from .learner import Learner, to_optax
 from .ring_attention import ring_attention, ring_attention_sharded
 from .pipeline import pipeline_apply, pipeline_sharded
 from .moe import moe_apply, moe_sharded
+from .five_axis import (build_five_axis_train_step, init_five_axis_params,
+                        five_axis_specs)
 
 __all__ = ["make_mesh", "default_mesh", "replicated", "shard_batch",
            "shard_params", "AxisNames", "all_reduce", "all_gather",
            "reduce_scatter", "ppermute", "axis_index", "axis_size",
            "Learner", "to_optax", "ring_attention",
            "ring_attention_sharded", "pipeline_apply", "pipeline_sharded",
-           "moe_apply", "moe_sharded"]
+           "moe_apply", "moe_sharded", "build_five_axis_train_step",
+           "init_five_axis_params", "five_axis_specs"]

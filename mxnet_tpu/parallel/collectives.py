@@ -48,3 +48,47 @@ def axis_index(axis_name):
 
 def axis_size(axis_name):
     return lax.psum(1, axis_name)
+
+
+def _vma(x):
+    """The varying-manual-axes set of a value/aval ({} on older jax)."""
+    try:
+        aval = x if hasattr(x, "vma") else jax.typeof(x)
+        return getattr(aval, "vma", frozenset())
+    except Exception:  # noqa: BLE001 — outside shard_map / old jax
+        return frozenset()
+
+
+def match_carry_vma(step_fn, carry, *xs_protos):
+    """Promote literal-zero scan carries to the loop body's varying axes.
+
+    Under shard_map, jax tracks which mesh axes a value *varies* over (vma).
+    A scan carry initialized from literals is axis-invariant, but the loop
+    body usually returns values varying over the axes its collectives /
+    ``axis_index`` touch — and scan requires carry types to be identical
+    across iterations. This runs ``jax.eval_shape`` on one abstract step
+    (zero FLOPs) and ``lax.pcast``s each init leaf up to the vma the body
+    produces. No-op when the vma system is absent (older jax).
+    """
+    if not (hasattr(jax, "typeof") and hasattr(lax, "pcast")):
+        return carry
+
+    def up(leaf, aval):
+        need = tuple(sorted(_vma(aval) - _vma(leaf)))
+        return lax.pcast(leaf, need, to="varying") if need else leaf
+
+    # iterate to a vma fixpoint: the carry feeds back into the body, so one
+    # abstract pass can under-approximate (bounded by the mesh's axis count)
+    for _ in range(8):
+        try:
+            out = jax.eval_shape(lambda c: step_fn(c, *xs_protos)[0], carry)
+        except Exception:  # noqa: BLE001 — abstract eval failed: keep init
+            return carry
+        grew = any(
+            _vma(a) - _vma(c)
+            for c, a in zip(jax.tree_util.tree_leaves(carry),
+                            jax.tree_util.tree_leaves(out)))
+        if not grew:
+            return carry
+        carry = jax.tree_util.tree_map(up, carry, out)
+    return carry

@@ -1,0 +1,198 @@
+"""One training step over ALL five parallelism axes in a single program.
+
+The reference framework's distributed story is data parallelism plus manual
+model parallelism (SURVEY §2.2); this module is the TPU-native superset: a
+single jit-compiled SPMD training step over a ``Mesh`` with axis groups
+
+    dp — batch sharding (gradient psum)
+    tp — Megatron-style column/row-parallel attention projections (psum)
+    pp — GPipe pipeline over stacked stages (ppermute ring)
+    sp — ring attention over the sequence axis (ppermute ring)
+    ep — mixture-of-experts token dispatch (all_to_all)
+
+Model: a residual pre-norm transformer stack. Each pipeline stage is one
+block: RMSNorm → multi-head ring attention (qkv column-parallel over tp,
+output row-parallel + psum) → RMSNorm → top-1 MoE FFN (experts sharded over
+ep, tokens split/all_to_all'd/gathered). The whole fwd+bwd+SGD update is one
+XLA program; every collective rides the mesh (ICI on real hardware).
+
+This is what ``__graft_entry__.dryrun_multichip`` compiles each round to
+certify the multi-chip story without real chips.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from .moe import moe_apply
+from .pipeline import pipeline_apply
+from .ring_attention import ring_attention
+
+__all__ = ["five_axis_specs", "init_five_axis_params",
+           "build_five_axis_train_step"]
+
+_FIVE = ("dp", "tp", "pp", "sp", "ep")
+
+
+def _rmsnorm(x, g):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * g
+
+
+def five_axis_specs(n_heads):
+    """PartitionSpecs for the stage-stacked parameter pytree (leading axis =
+    pipeline stage, sharded over pp)."""
+    return {
+        "ln1": P("pp", None),
+        "wq": P("pp", None, "tp"),
+        "wk": P("pp", None, "tp"),
+        "wv": P("pp", None, "tp"),
+        "wo": P("pp", "tp", None),
+        "ln2": P("pp", None),
+        "gate": P("pp", None, None),
+        "w1": P("pp", "ep", None, None),
+        "w2": P("pp", "ep", None, None),
+    }
+
+
+def init_five_axis_params(rng, n_stages, d_model, n_heads, n_experts, d_ff,
+                          n_classes, dtype=jnp.float32):
+    """Stage-stacked transformer-MoE parameters (host numpy → jax)."""
+    import numpy as onp
+
+    r = onp.random.RandomState(rng)
+    s = 0.05
+
+    def w(*shape):
+        return jnp.asarray(r.randn(*shape).astype("float32") * s, dtype)
+
+    stages = {
+        "ln1": jnp.ones((n_stages, d_model), dtype),
+        "wq": w(n_stages, d_model, d_model),
+        "wk": w(n_stages, d_model, d_model),
+        "wv": w(n_stages, d_model, d_model),
+        "wo": w(n_stages, d_model, d_model),
+        "ln2": jnp.ones((n_stages, d_model), dtype),
+        "gate": w(n_stages, d_model, n_experts),
+        "w1": w(n_stages, n_experts, d_model, d_ff),
+        "w2": w(n_stages, n_experts, d_ff, d_model),
+    }
+    return {"stages": stages, "out_w": w(d_model, n_classes)}
+
+
+def _block(p, x, n_heads, moe_capacity):
+    """One transformer block on one device's shard. x: (mb, T_local, D)."""
+    mb, t, d = x.shape
+    tp_n = lax.psum(1, "tp")
+    h_local = n_heads // tp_n
+
+    # -- attention: column-parallel qkv (local out-features), ring over sp --
+    h = _rmsnorm(x, p["ln1"])
+
+    def heads(a):  # (mb, T, d/tp) -> (mb, h_local, T, hd)
+        return a.reshape(mb, t, h_local, -1).transpose(0, 2, 1, 3)
+
+    q, k, v = (heads(h @ p[n]) for n in ("wq", "wk", "wv"))
+    attn = ring_attention(q, k, v, axis_name="sp", causal=True)
+    attn = attn.transpose(0, 2, 1, 3).reshape(mb, t, -1)
+    # row-parallel output projection: partial matmul + psum over tp
+    attn = lax.psum(attn @ p["wo"], "tp")
+    x = x + attn
+
+    # -- MoE FFN: tokens split over ep, all_to_all dispatch, gather back --
+    h2 = _rmsnorm(x, p["ln2"]).reshape(mb * t, d)
+    ep_n = lax.psum(1, "ep")
+    ep_i = lax.axis_index("ep")
+    chunk = (mb * t) // ep_n
+    xe = lax.dynamic_slice_in_dim(h2, ep_i * chunk, chunk, axis=0)
+    ye = moe_apply(xe, p["gate"], p["w1"], p["w2"], axis_name="ep",
+                   capacity=moe_capacity)
+    yfull = lax.all_gather(ye, "ep", axis=0, tiled=True)
+    return x + yfull.reshape(mb, t, d)
+
+
+def _loss_body(params, x, y, n_heads, num_microbatches, moe_capacity):
+    """Per-shard loss (inside shard_map). x: (B_local, T_local, D) block of
+    the (dp, sp)-sharded input; y: (B_local, T_local) int labels."""
+    b, t, d = x.shape
+    if b % num_microbatches:
+        raise MXNetError(
+            f"local batch {b} not divisible by {num_microbatches} "
+            "microbatches")
+    mb = b // num_microbatches
+    xmb = x.reshape(num_microbatches, mb, t, d)
+    stage_fn = functools.partial(_block, n_heads=n_heads,
+                                 moe_capacity=moe_capacity)
+    out = pipeline_apply(stage_fn, params["stages"], xmb, axis_name="pp")
+    out = out.reshape(b, t, d)
+    logits = out @ params["out_w"]  # (B_local, T_local, C)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, y[..., None], axis=-1)[..., 0]
+    # mean over the global batch: psum the (dp, sp)-sharded partial sums
+    total = lax.psum(jnp.sum(nll), ("dp", "sp"))
+    count = lax.psum(jnp.float32(nll.size), ("dp", "sp"))
+    # the value is already equal on every tp/pp/ep member (psum over tp,
+    # pipeline psum over pp, all_gather over ep) but may still be TYPED as
+    # varying over some of them; pmean over exactly those axes certifies
+    # replication so out_specs=P() holds
+    from .collectives import _vma
+
+    val = total / count
+    rem = tuple(sorted(_vma(val)))
+    return lax.pmean(val, rem) if rem else val
+
+
+def build_five_axis_train_step(mesh, n_heads, lr=0.1, num_microbatches=None,
+                               moe_capacity=8):
+    """Compile fwd+bwd+SGD over a 5-axis mesh. Returns (step, place).
+
+    ``place(params, x, y)`` pins arrays to their mesh shardings;
+    ``step(params, x, y) -> (new_params, loss)`` is the jit'd program.
+    Constraints (all checked): stage count == pp size; n_heads % tp == 0;
+    experts % ep == 0; local tokens % ep == 0.
+    """
+    missing = [a for a in _FIVE if a not in mesh.shape]
+    if missing:
+        raise MXNetError(
+            f"five-axis step needs mesh axes {_FIVE}; missing {missing}")
+    num_microbatches = num_microbatches or max(mesh.shape["pp"], 1)
+    if n_heads % mesh.shape["tp"]:
+        raise MXNetError(f"n_heads {n_heads} not divisible by tp size "
+                         f"{mesh.shape['tp']}")
+
+    stage_specs = five_axis_specs(n_heads)
+    param_specs = {"stages": stage_specs, "out_w": P(None, None)}
+    x_spec, y_spec = P("dp", "sp", None), P("dp", "sp")
+
+    from jax import shard_map
+
+    loss_sm = shard_map(
+        functools.partial(_loss_body, n_heads=n_heads,
+                          num_microbatches=num_microbatches,
+                          moe_capacity=moe_capacity),
+        mesh=mesh,
+        in_specs=(param_specs, x_spec, y_spec),
+        out_specs=P(),
+    )
+
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_sm)(params, x, y)
+        new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new, loss
+
+    def place(params, x, y):
+        def pin(tree, specs):
+            return jax.tree_util.tree_map(
+                lambda a, sp: jax.device_put(a, NamedSharding(mesh, sp)),
+                tree, specs)
+
+        return (pin(params, param_specs),
+                jax.device_put(x, NamedSharding(mesh, x_spec)),
+                jax.device_put(y, NamedSharding(mesh, y_spec)))
+
+    return jax.jit(step), place

@@ -63,10 +63,13 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
 
     buf0 = jnp.zeros(mb_shape, microbatches.dtype)
     outs0 = jnp.zeros((m,) + mb_shape, microbatches.dtype)
-    if hasattr(lax, "pcast"):
-        # literal-zero carries are axis-invariant; the loop makes them vary
-        buf0, outs0 = (lax.pcast(z, (axis_name,), to="varying")
-                       for z in (buf0, outs0))
+    # literal-zero carries are axis-invariant; promote to the exact varying
+    # axes the tick body produces (pp from the schedule masks, plus any axes
+    # the stage_fn's own collectives leave varying — dp/sp/ep under a
+    # multi-axis mesh)
+    from .collectives import match_carry_vma
+
+    buf0, outs0 = match_carry_vma(tick, (buf0, outs0), jnp.int32(0))
     (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(total))
     # broadcast the last stage's outputs to every shard so the caller gets
     # identical values regardless of which shard it reads

@@ -85,13 +85,14 @@ def ring_attention(q, k, v, axis_name, scale=None, causal=False):
     acc0 = jnp.zeros((b, h, tq, d), jnp.float32)
     m0 = jnp.full((b, h, tq, 1), -1e30, jnp.float32)
     l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
-    # initial accumulators are literal zeros (axis-invariant); mark them as
-    # varying over the ring axis so the scan carry types line up
-    if hasattr(lax, "pcast"):
-        acc0, m0, l0 = (lax.pcast(x, (axis_name,), to="varying")
-                        for x in (acc0, m0, l0))
-    (acc, m, l, _, _, _), _ = lax.scan(
-        step, (acc0, m0, l0, k, v, jnp.int32(my)), None, length=n)
+    # initial accumulators are literal zeros (axis-invariant); promote them
+    # to exactly the varying axes the loop body produces — not just the
+    # ring axis: under a multi-axis mesh q/k/v can vary over dp/tp/pp too
+    from .collectives import match_carry_vma
+
+    carry0 = match_carry_vma(
+        lambda c, _x: step(c, _x), (acc0, m0, l0, k, v, jnp.int32(my)), None)
+    (acc, m, l, _, _, _), _ = lax.scan(step, carry0, None, length=n)
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
