@@ -98,11 +98,16 @@ class Trainer:
             self._init_kvstore()
         if self._kvstore is None or self._kvstore.num_workers == 1:
             return
+        # ONE batched call: the distributed store fuses the whole parameter
+        # list into one collective per dtype bucket instead of one per key
+        keys, grads = [], []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
-            g = p.grad()
-            self._kvstore.pushpull(i, g, out=g, priority=-i)
+            keys.append(i)
+            grads.append(p.grad())
+        if keys:
+            self._kvstore.pushpull(keys, grads, out=grads)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Rescale grads by 1/batch_size, allreduce, update.
@@ -115,12 +120,16 @@ class Trainer:
         if self._kvstore is not None and self._update_on_kvstore:
             # optimizer runs on the store (reference update_on_kvstore):
             # pushpull applies the store-side updater and writes the new
-            # weight back — works with any worker count
+            # weight back — one batched call for the whole parameter list
+            keys, grads, weights = [], [], []
             for i, p in enumerate(self._params):
                 if p.grad_req == "null":
                     continue
-                self._kvstore.pushpull(i, p.grad(), out=p.data(),
-                                       priority=-i)
+                keys.append(i)
+                grads.append(p.grad())
+                weights.append(p.data())
+            if keys:
+                self._kvstore.pushpull(keys, grads, out=weights)
             return
         if self._kvstore is not None and self._kvstore.num_workers > 1:
             self.allreduce_grads()

@@ -121,10 +121,12 @@ class KVStore(KVStoreBase):
 
     def push(self, key, value, priority=0):
         keys, vals = _keys_vals(key, value)
-        for k, v in zip(keys, vals):
-            # reduce locally, then across workers (reference: server-side
-            # merge of all workers' pushes, kvstore_dist_server.h:346)
-            red = self._global_reduce(self._reduce(v, key=k))
+        # reduce locally, then across workers in ONE batched collective per
+        # dtype bucket (reference: server-side merge of all workers' pushes,
+        # kvstore_dist_server.h:346; bucketing analog: P3's sliced pushes)
+        reds = self._global_reduce_many(
+            [self._reduce(v, key=k) for k, v in zip(keys, vals)])
+        for k, red in zip(keys, reds):
             if self._updater is not None:
                 if k not in self._store:
                     self._store[k] = NDArray(red)
@@ -143,12 +145,14 @@ class KVStore(KVStoreBase):
                 dst._set_data(src.as_in_ctx(dst.ctx)._data)
 
     def pushpull(self, key, value, out=None, priority=0):
-        """Fused allreduce (reference: kvstore.h:237 PushPull)."""
+        """Fused allreduce (reference: kvstore.h:237 PushPull). Multi-key
+        calls run one cross-worker collective per dtype bucket, not one per
+        key — Trainer batches its whole parameter list into a single call."""
         keys, vals = _keys_vals(key, value)
         outs = [None] * len(keys) if out is None else _keys_vals(key, out)[1]
-        for k, v, o in zip(keys, vals, outs):
-            red = self._reduce(v, key=k)
-            red = self._global_reduce(red)
+        reds = self._global_reduce_many(
+            [self._reduce(v, key=k) for k, v in zip(keys, vals)])
+        for k, red, o in zip(keys, reds, outs):
             if self._updater is not None and o is not None:
                 if k not in self._store:
                     self._store[k] = NDArray(_as_list(o)[0]._data)
@@ -162,6 +166,11 @@ class KVStore(KVStoreBase):
 
     def _global_reduce(self, data):
         return data  # single process
+
+    def _global_reduce_many(self, datas):
+        """Cross-worker sum of a LIST of local arrays; overridden by the
+        distributed store to run one fused collective per dtype bucket."""
+        return [self._global_reduce(d) for d in datas]
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)
@@ -244,14 +253,78 @@ class Dist_Sync(KVStore):
         _ensure_distributed()
         self._nproc = jax.process_count()
         self._rank = jax.process_index()
+        self._reduce_mesh = None
+        self._reducer_cache = {}
+        # observability: number of fused cross-worker collectives issued
+        # (asserted by tests/nightly/dist_sync_kvstore.py — one per dtype
+        # bucket per pushpull call, NOT one per key)
+        self.fused_reduction_count = 0
+
+    def _get_reduce_mesh(self):
+        """A 1-axis mesh with ONE device per process (the allreduce rides
+        DCN/ICI between hosts; intra-host devices are not part of this
+        facade's contract — the Learner path owns those)."""
+        if self._reduce_mesh is None:
+            import jax
+            import numpy as onp
+            from jax.sharding import Mesh
+
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            devs = [per_proc[p] for p in range(self._nproc)]
+            self._reduce_mesh = Mesh(onp.array(devs), ("h",))
+        return self._reduce_mesh
+
+    def _global_reduce_many(self, datas):
+        """ONE jit'd cross-worker sum per dtype bucket (replaces the round-2
+        per-key ``process_allgather`` + host-side sum, which gathered every
+        gradient to every host through host memory).
+
+        Mechanism: concatenate the bucket into a flat buffer, assemble a
+        global (nproc, n) array whose shard rows are each worker's local
+        buffer, and run a compiled ``sum(axis=0)`` with a replicated output
+        — XLA lowers this to a single all-reduce on the wire (semantics of
+        the ps-lite server merge, kvstore_dist.h:218, without the server).
+        """
+        if self._nproc == 1 or not datas:
+            return datas
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._get_reduce_mesh()
+        my_dev = mesh.devices.flat[self._rank]
+        out = [None] * len(datas)
+        buckets = {}
+        for i, d in enumerate(datas):
+            buckets.setdefault(str(d.dtype), []).append(i)
+        for dt, idxs in sorted(buckets.items()):
+            flat = jnp.concatenate([datas[i].ravel() for i in idxs]) \
+                if len(idxs) > 1 else datas[idxs[0]].ravel()
+            n = int(flat.size)
+            local = jax.device_put(flat[None, :], my_dev)
+            garr = jax.make_array_from_single_device_arrays(
+                (self._nproc, n), NamedSharding(mesh, P("h")), [local])
+            key = (n, dt)
+            reducer = self._reducer_cache.get(key)
+            if reducer is None:
+                reducer = jax.jit(
+                    lambda a: a.sum(axis=0),
+                    out_shardings=NamedSharding(mesh, P()))
+                self._reducer_cache[key] = reducer
+            reduced = reducer(garr)
+            self.fused_reduction_count += 1
+            host_flat = reduced.addressable_data(0)
+            off = 0
+            for i in idxs:
+                sz = datas[i].size
+                out[i] = host_flat[off:off + sz].reshape(datas[i].shape)
+                off += sz
+        return out
 
     def _global_reduce(self, data):
-        if self._nproc == 1:
-            return data
-        from jax.experimental import multihost_utils
-
-        gathered = multihost_utils.process_allgather(data)
-        return gathered.sum(axis=0)
+        return self._global_reduce_many([data])[0]
 
     @property
     def rank(self):

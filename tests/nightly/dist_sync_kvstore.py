@@ -47,6 +47,19 @@ def main():
     kv.pushpull("key1", grad2, out=out2)
     check_diff(out2, 2.0 * expected)
 
+    # batched multi-key pushpull: ONE fused collective per dtype bucket
+    # (not one per key), numerically identical to per-key reduction
+    before = kv.fused_reduction_count
+    gs = [np.ones((4, 3)) * (rank + 1), np.ones((7,)) * 10 * (rank + 1),
+          np.ones((2, 2, 2)) * 100 * (rank + 1)]
+    outs = [np.zeros((4, 3)), np.zeros((7,)), np.zeros((2, 2, 2))]
+    kv.pushpull(["a", "b", "c"], gs, out=outs)
+    assert kv.fused_reduction_count - before == 1, \
+        f"expected 1 fused reduction, got {kv.fused_reduction_count - before}"
+    check_diff(outs[0], expected)
+    check_diff(outs[1], 10 * expected)
+    check_diff(outs[2], 100 * expected)
+
     # barrier then trainer-style flow: grads averaged into weights
     kv.barrier()
     from mxnet_tpu import autograd, gluon
