@@ -172,13 +172,44 @@ def bench_bert_pretrain(size="base"):
 
 
 def _accel_expected():
-    """True when this machine is configured for an accelerator: either
-    JAX_PLATFORMS names a non-CPU platform, or a PJRT plugin site hook is
-    installed (the axon tunnel registers itself in every process)."""
+    """True when this machine is configured for an accelerator, so a CPU
+    result must be reported as a failure rather than published silently:
+    - MXTPU_EXPECT_ACCEL=1 (explicit operator statement — most reliable),
+    - JAX_PLATFORMS names a non-CPU platform,
+    - a PJRT plugin is importable in this interpreter (an importable
+      ``axon`` site hook or any registered ``jax_plugins`` entry point) —
+      detection by import machinery, not deployment-specific path grepping.
+    """
+    if os.environ.get("MXTPU_EXPECT_ACCEL", "") == "1":
+        return True
     plats = os.environ.get("JAX_PLATFORMS", "")
     if any(p.strip() not in ("", "cpu") for p in plats.split(",")):
         return True
-    return any("axon" in p for p in os.environ.get("PYTHONPATH", "").split(":"))
+    import importlib.metadata
+    import importlib.util
+
+    try:
+        if importlib.util.find_spec("axon") is not None:
+            return True
+    except (ImportError, ValueError):
+        pass
+    # jax discovers plugins both via jax_plugins.* namespace packages and
+    # via entry points; mirror both mechanisms, skipping cpu-only plugins
+    try:
+        spec = importlib.util.find_spec("jax_plugins")
+    except (ImportError, ValueError):
+        spec = None
+    if spec is not None and spec.submodule_search_locations:
+        import pkgutil
+
+        if any(m.name != "cpu" for m in
+               pkgutil.iter_modules(spec.submodule_search_locations)):
+            return True
+    try:
+        return any(ep.name != "cpu" for ep in
+                   importlib.metadata.entry_points(group="jax_plugins"))
+    except Exception:  # noqa: BLE001 — metadata backends vary
+        return False
 
 
 def main():

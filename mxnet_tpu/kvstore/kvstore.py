@@ -20,6 +20,7 @@ multi-device values, PushPull fuses both, optional optimizer-on-store
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 from ..base import MXNetError
@@ -256,8 +257,9 @@ class Dist_Sync(KVStore):
         self._reduce_mesh = None
         self._reducer_cache = {}
         # observability: number of fused cross-worker collectives issued
-        # (asserted by tests/nightly/dist_sync_kvstore.py — one per dtype
-        # bucket per pushpull call, NOT one per key)
+        # (asserted by tests/nightly/dist_sync_kvstore.py — one per
+        # cap-sized chunk per dtype bucket per pushpull call, NOT one per
+        # key; a bucket under MXTPU_KVSTORE_BUCKET_BYTES is one collective)
         self.fused_reduction_count = 0
 
     def _get_reduce_mesh(self):
@@ -299,28 +301,78 @@ class Dist_Sync(KVStore):
         buckets = {}
         for i, d in enumerate(datas):
             buckets.setdefault(str(d.dtype), []).append(i)
+        # Stream each dtype bucket through exact cap-sized wire buffers
+        # (tensors are sliced across chunk boundaries): every full chunk is
+        # exactly `cap` elements, so the compile cache holds at most two
+        # entries per dtype (cap + current tail size) regardless of how the
+        # parameter list evolves, and the transient concat buffer is bounded
+        # by the cap instead of ~total-gradient-sized.
+        cap_bytes = int(os.environ.get(
+            "MXTPU_KVSTORE_BUCKET_BYTES", 64 * 1024 * 1024))
         for dt, idxs in sorted(buckets.items()):
-            flat = jnp.concatenate([datas[i].ravel() for i in idxs]) \
-                if len(idxs) > 1 else datas[idxs[0]].ravel()
-            n = int(flat.size)
-            local = jax.device_put(flat[None, :], my_dev)
-            garr = jax.make_array_from_single_device_arrays(
-                (self._nproc, n), NamedSharding(mesh, P("h")), [local])
-            key = (n, dt)
-            reducer = self._reducer_cache.get(key)
-            if reducer is None:
-                reducer = jax.jit(
-                    lambda a: a.sum(axis=0),
-                    out_shardings=NamedSharding(mesh, P()))
-                self._reducer_cache[key] = reducer
-            reduced = reducer(garr)
-            self.fused_reduction_count += 1
-            host_flat = reduced.addressable_data(0)
-            off = 0
+            itemsize = datas[idxs[0]].dtype.itemsize
+            cap = max(1, cap_bytes // itemsize)
+
+            def get_reducer(n):
+                # full-cap chunks share one permanent entry; the odd-sized
+                # tail gets a single replaceable slot per dtype so stale
+                # tail sizes never accumulate (the two-entry-per-dtype bound)
+                if n == cap:
+                    key, prev_n = (dt, "cap"), cap
+                else:
+                    key = (dt, "tail")
+                    prev_n = (self._reducer_cache.get(key) or (None,))[0]
+                ent = self._reducer_cache.get(key)
+                if ent is None or prev_n != n:
+                    fn = jax.jit(lambda a: a.sum(axis=0),
+                                 out_shardings=NamedSharding(mesh, P()))
+                    ent = (n, fn)
+                    self._reducer_cache[key] = ent
+                return ent[1]
+
+            def reduce_chunk(pieces, n):
+                flat = jnp.concatenate(pieces) if len(pieces) > 1 \
+                    else pieces[0]
+                local = jax.device_put(flat[None, :], my_dev)
+                garr = jax.make_array_from_single_device_arrays(
+                    (self._nproc, n), NamedSharding(mesh, P("h")), [local])
+                self.fused_reduction_count += 1
+                return get_reducer(n)(garr).addressable_data(0)
+
+            parts, pieces, n_cur = [], [], 0
             for i in idxs:
-                sz = datas[i].size
-                out[i] = host_flat[off:off + sz].reshape(datas[i].shape)
-                off += sz
+                t = datas[i].ravel()
+                off, sz = 0, int(t.size)
+                while off < sz:
+                    take = min(sz - off, cap - n_cur)
+                    pieces.append(t[off:off + take])
+                    n_cur += take
+                    off += take
+                    if n_cur == cap:
+                        parts.append(reduce_chunk(pieces, cap))
+                        pieces, n_cur = [], 0
+            if n_cur:
+                parts.append(reduce_chunk(pieces, n_cur))
+
+            # reassemble per-tensor views: full parts are cap-aligned, so a
+            # tensor at flat offset g spans parts g//cap .. (g+size-1)//cap
+            def span(start, size):
+                if size == 0:
+                    return jnp.zeros((0,), datas[idxs[0]].dtype)
+                segs = []
+                while size:
+                    k, o = divmod(start, cap)
+                    n = min(size, int(parts[k].shape[0]) - o)
+                    segs.append(parts[k][o:o + n])
+                    start += n
+                    size -= n
+                return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+
+            g = 0
+            for i in idxs:
+                sz = int(datas[i].size)
+                out[i] = span(g, sz).reshape(datas[i].shape)
+                g += sz
         return out
 
     def _global_reduce(self, data):

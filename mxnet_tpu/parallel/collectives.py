@@ -59,7 +59,7 @@ def _vma(x):
         return frozenset()
 
 
-def match_carry_vma(step_fn, carry, *xs_protos):
+def match_carry_vma(step_fn, carry, *xs_protos, fallback_axis=None):
     """Promote literal-zero scan carries to the loop body's varying axes.
 
     Under shard_map, jax tracks which mesh axes a value *varies* over (vma).
@@ -69,6 +69,11 @@ def match_carry_vma(step_fn, carry, *xs_protos):
     across iterations. This runs ``jax.eval_shape`` on one abstract step
     (zero FLOPs) and ``lax.pcast``s each init leaf up to the vma the body
     produces. No-op when the vma system is absent (older jax).
+
+    If the abstract eval itself fails, falls back to promoting every leaf
+    over ``fallback_axis`` (the caller's primary ring axis) — the carry is
+    guaranteed to vary over at least that axis, and an unpromoted carry
+    would only re-surface later as an opaque scan carry-type mismatch.
     """
     if not (hasattr(jax, "typeof") and hasattr(lax, "pcast")):
         return carry
@@ -77,13 +82,25 @@ def match_carry_vma(step_fn, carry, *xs_protos):
         need = tuple(sorted(_vma(aval) - _vma(leaf)))
         return lax.pcast(leaf, need, to="varying") if need else leaf
 
+    def promote_fallback(tree):
+        if fallback_axis is None:
+            return tree
+        ax = (fallback_axis,) if isinstance(fallback_axis, str) \
+            else tuple(fallback_axis)
+
+        def one(leaf):
+            need = tuple(a for a in ax if a not in _vma(leaf))
+            return lax.pcast(leaf, need, to="varying") if need else leaf
+
+        return jax.tree_util.tree_map(one, tree)
+
     # iterate to a vma fixpoint: the carry feeds back into the body, so one
     # abstract pass can under-approximate (bounded by the mesh's axis count)
     for _ in range(8):
         try:
             out = jax.eval_shape(lambda c: step_fn(c, *xs_protos)[0], carry)
-        except Exception:  # noqa: BLE001 — abstract eval failed: keep init
-            return carry
+        except Exception:  # noqa: BLE001 — abstract eval failed
+            return promote_fallback(carry)
         grew = any(
             _vma(a) - _vma(c)
             for c, a in zip(jax.tree_util.tree_leaves(carry),

@@ -120,11 +120,7 @@ def _loss_body(params, x, y, n_heads, num_microbatches, moe_capacity):
     """Per-shard loss (inside shard_map). x: (B_local, T_local, D) block of
     the (dp, sp)-sharded input; y: (B_local, T_local) int labels."""
     b, t, d = x.shape
-    if b % num_microbatches:
-        raise MXNetError(
-            f"local batch {b} not divisible by {num_microbatches} "
-            "microbatches")
-    mb = b // num_microbatches
+    mb = b // num_microbatches  # divisibility checked in validate()
     xmb = x.reshape(num_microbatches, mb, t, d)
     stage_fn = functools.partial(_block, n_heads=n_heads,
                                  moe_capacity=moe_capacity)
@@ -169,6 +165,40 @@ def build_five_axis_train_step(mesh, n_heads, lr=0.1, num_microbatches=None,
     param_specs = {"stages": stage_specs, "out_w": P(None, None)}
     x_spec, y_spec = P("dp", "sp", None), P("dp", "sp")
 
+    def validate(params, x):
+        """Trace-time shape checks (static shapes; raises before compile).
+
+        pipeline_apply consumes exactly ONE stage per pp shard — a stage
+        count that merely *divides* pp would shard to local length >1 and
+        silently drop layers, so equality is required, not divisibility.
+        """
+        pp, ep = mesh.shape["pp"], mesh.shape["ep"]
+        dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+        for name, leaf in params["stages"].items():
+            if leaf.shape[0] != pp:
+                raise MXNetError(
+                    f"stage leaf {name!r} has {leaf.shape[0]} stages but the "
+                    f"mesh has pp={pp}; the pipeline runs exactly one stage "
+                    "per pp shard (extra stages would be silently dropped)")
+        n_experts = params["stages"]["gate"].shape[-1]
+        if n_experts % ep:
+            raise MXNetError(
+                f"n_experts {n_experts} not divisible by ep size {ep}")
+        b, t = x.shape[0], x.shape[1]
+        if b % dp or t % sp:
+            raise MXNetError(
+                f"batch {b} / seq {t} not divisible by dp={dp} / sp={sp}")
+        b_local, t_local = b // dp, t // sp
+        if b_local % num_microbatches:
+            raise MXNetError(
+                f"local batch {b_local} not divisible by "
+                f"{num_microbatches} microbatches")
+        tokens = (b_local // num_microbatches) * t_local
+        if tokens % ep:
+            raise MXNetError(
+                f"local microbatch tokens {tokens} not divisible by ep size "
+                f"{ep}; the MoE dispatch would silently truncate tokens")
+
     from jax import shard_map
 
     loss_sm = shard_map(
@@ -181,6 +211,7 @@ def build_five_axis_train_step(mesh, n_heads, lr=0.1, num_microbatches=None,
     )
 
     def step(params, x, y):
+        validate(params, x)
         loss, grads = jax.value_and_grad(loss_sm)(params, x, y)
         new = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
         return new, loss

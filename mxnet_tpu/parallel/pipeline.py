@@ -69,7 +69,8 @@ def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):
     # multi-axis mesh)
     from .collectives import match_carry_vma
 
-    buf0, outs0 = match_carry_vma(tick, (buf0, outs0), jnp.int32(0))
+    buf0, outs0 = match_carry_vma(tick, (buf0, outs0), jnp.int32(0),
+                                  fallback_axis=axis_name)
     (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(total))
     # broadcast the last stage's outputs to every shard so the caller gets
     # identical values regardless of which shard it reads

@@ -91,7 +91,8 @@ def ring_attention(q, k, v, axis_name, scale=None, causal=False):
     from .collectives import match_carry_vma
 
     carry0 = match_carry_vma(
-        lambda c, _x: step(c, _x), (acc0, m0, l0, k, v, jnp.int32(my)), None)
+        lambda c, _x: step(c, _x), (acc0, m0, l0, k, v, jnp.int32(my)), None,
+        fallback_axis=axis_name)
     (acc, m, l, _, _, _), _ = lax.scan(step, carry0, None, length=n)
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 

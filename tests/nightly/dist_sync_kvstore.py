@@ -47,18 +47,44 @@ def main():
     kv.pushpull("key1", grad2, out=out2)
     check_diff(out2, 2.0 * expected)
 
-    # batched multi-key pushpull: ONE fused collective per dtype bucket
-    # (not one per key), numerically identical to per-key reduction
+    # batched multi-key pushpull: one fused collective per cap-sized chunk
+    # per dtype bucket (not one per key), numerically identical to per-key
+    # reduction. 27 float32 elements fit one default-cap chunk.
+    import math
+
+    cap_elems = max(1, int(os.environ.get(
+        "MXTPU_KVSTORE_BUCKET_BYTES", 64 * 1024 * 1024)) // 4)
     before = kv.fused_reduction_count
     gs = [np.ones((4, 3)) * (rank + 1), np.ones((7,)) * 10 * (rank + 1),
           np.ones((2, 2, 2)) * 100 * (rank + 1)]
     outs = [np.zeros((4, 3)), np.zeros((7,)), np.zeros((2, 2, 2))]
     kv.pushpull(["a", "b", "c"], gs, out=outs)
-    assert kv.fused_reduction_count - before == 1, \
-        f"expected 1 fused reduction, got {kv.fused_reduction_count - before}"
+    got = kv.fused_reduction_count - before
+    want = math.ceil(27 / cap_elems)
+    assert got == want, f"expected {want} fused reductions, got {got}"
     check_diff(outs[0], expected)
     check_diff(outs[1], 10 * expected)
     check_diff(outs[2], 100 * expected)
+
+    # force multi-chunk streaming (4 elements per chunk → tensors are
+    # sliced across chunk boundaries) and check numerics are unchanged
+    prior_cap = os.environ.get("MXTPU_KVSTORE_BUCKET_BYTES")
+    os.environ["MXTPU_KVSTORE_BUCKET_BYTES"] = "16"
+    try:
+        before = kv.fused_reduction_count
+        outs2 = [np.zeros((4, 3)), np.zeros((7,)), np.zeros((2, 2, 2))]
+        kv.pushpull(["a2", "b2", "c2"], gs, out=outs2)
+        got = kv.fused_reduction_count - before
+        assert got == math.ceil(27 / 4), \
+            f"expected {math.ceil(27 / 4)} chunked reductions, got {got}"
+        check_diff(outs2[0], expected)
+        check_diff(outs2[1], 10 * expected)
+        check_diff(outs2[2], 100 * expected)
+    finally:
+        if prior_cap is None:
+            del os.environ["MXTPU_KVSTORE_BUCKET_BYTES"]
+        else:
+            os.environ["MXTPU_KVSTORE_BUCKET_BYTES"] = prior_cap
 
     # barrier then trainer-style flow: grads averaged into weights
     kv.barrier()
