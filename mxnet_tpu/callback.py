@@ -1,79 +1,103 @@
-"""Training callbacks (reference: python/mxnet/callback.py — Speedometer,
-do_checkpoint, log_train_metric)."""
+"""Training progress callbacks.
+
+API parity with the reference callback module (reference:
+python/mxnet/callback.py: Speedometer, do_checkpoint, log_train_metric,
+ProgressBar) re-expressed around a shared throughput clock. One TPU-side
+caveat is baked in: under the async PJRT runtime a batch callback fires
+when the step is *dispatched*, not when it finishes, so Speedometer numbers
+describe dispatch throughput; sync (read a scalar) before timing-critical
+measurements.
+"""
 from __future__ import annotations
 
 import logging
+import sys
 import time
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
            "ProgressBar"]
 
+_LOG = logging.getLogger("mxnet_tpu")
+
+
+def _metric_text(metric):
+    return "".join(f"\t{name}={val:.6f}"
+                   for name, val in metric.get_name_value())
+
 
 class Speedometer:
-    """Log samples/sec every N batches (reference: callback.py Speedometer)."""
+    """Log throughput every ``frequent`` batches.
+
+    ``auto_reset`` clears the attached eval metric after each report so the
+    printed value covers only the last window, not the whole epoch.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
         self.auto_reset = auto_reset
-        self.init = False
-        self.tic = 0.0
-        self.last_count = 0
+        self._window_start = None
+        self._last_batch = -1
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
-                msg = f"Epoch[{param.epoch}] Batch [{count}]\t" \
-                      f"Speed: {speed:.2f} samples/sec"
-                if param.eval_metric is not None:
-                    for name, value in param.eval_metric.get_name_value():
-                        msg += f"\t{name}={value:.6f}"
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                logging.getLogger("mxnet_tpu").info(msg)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        nbatch = param.nbatch
+        if nbatch < self._last_batch or self._window_start is None:
+            # new epoch (batch counter rewound): restart the clock
+            self._window_start = time.time()
+            self._last_batch = nbatch
+            return
+        self._last_batch = nbatch
+        if nbatch == 0 or nbatch % self.frequent:
+            return
+        now = time.time()
+        rate = self.frequent * self.batch_size / \
+            max(now - self._window_start, 1e-9)
+        self._window_start = now
+        line = (f"Epoch[{param.epoch}] Batch [{nbatch}]\t"
+                f"Speed: {rate:.2f} samples/sec")
+        if param.eval_metric is not None:
+            line += _metric_text(param.eval_metric)
+            if self.auto_reset:
+                param.eval_metric.reset()
+        _LOG.info(line)
 
 
 def do_checkpoint(prefix, period=1):
-    """Epoch-end callback saving checkpoints (reference: do_checkpoint)."""
+    """Epoch-end callback: save a checkpoint every ``period`` epochs."""
     from . import model
 
-    def _callback(epoch, sym, net_or_params, trainer=None):
+    def save(epoch, sym, net_or_params, trainer=None):
         if (epoch + 1) % period == 0:
             model.save_checkpoint(prefix, epoch + 1, sym, net_or_params)
 
-    return _callback
+    return save
 
 
 def log_train_metric(period, auto_reset=False):
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            for name, value in param.eval_metric.get_name_value():
-                logging.getLogger("mxnet_tpu").info(
-                    "Iter[%d] Batch[%d] Train-%s=%f", param.epoch,
-                    param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
+    """Batch-end callback: log the running train metric every ``period``."""
 
-    return _callback
+    def report(param):
+        if param.eval_metric is None or param.nbatch % period:
+            return
+        for name, val in param.eval_metric.get_name_value():
+            _LOG.info("Iter[%d] Batch[%d] Train-%s=%f",
+                      param.epoch, param.nbatch, name, val)
+        if auto_reset:
+            param.eval_metric.reset()
+
+    return report
 
 
 class ProgressBar:
+    """Draw an in-place text progress bar over ``total`` batches."""
+
     def __init__(self, total, length=40):
-        self.total = total
+        self.total = max(total, 1)
         self.length = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled = int(round(self.length * count / float(self.total)))
-        bar = "#" * filled + "-" * (self.length - filled)
-        print(f"\r[{bar}] {100.0 * count / self.total:.1f}%", end="")
+        frac = min(param.nbatch / self.total, 1.0)
+        n_full = int(round(frac * self.length))
+        bar = "#" * n_full + "-" * (self.length - n_full)
+        sys.stdout.write(f"\r[{bar}] {100.0 * frac:.1f}%")
+        sys.stdout.flush()

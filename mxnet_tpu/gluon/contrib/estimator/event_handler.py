@@ -1,61 +1,156 @@
-"""Estimator event handlers (reference: gluon/contrib/estimator/
-event_handler.py — LoggingHandler:226, CheckpointHandler:336,
-EarlyStoppingHandler:614)."""
+"""Event handlers for the Estimator fit loop.
+
+API parity with the reference handler set (reference:
+gluon/contrib/estimator/event_handler.py — LoggingHandler:226,
+CheckpointHandler:336, EarlyStoppingHandler:614) on a local skeleton: the
+recurring machinery is factored into two helpers instead of being repeated
+per handler — ``_Every`` (epoch/batch periodic triggers, shared by
+validation and checkpointing) and ``_Better`` (metric improvement tests
+with min/max/auto direction resolution, shared by save-best and early
+stopping). ``mode='auto'`` infers direction from the metric name the way
+the reference does: accuracy-like metrics maximize, everything else
+(losses, errors) minimizes.
+
+Handlers run in priority order (most negative first); return True from a
+hook to request that training stop.
+"""
 from __future__ import annotations
 
 import logging
+import math
 import os
 import time
-
-import numpy as onp
 
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
            "BatchEnd", "StoppingHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
            "EarlyStoppingHandler", "GradientUpdateHandler"]
 
+_LOG = logging.getLogger("mxnet_tpu.estimator")
 
-class TrainBegin:
+
+class EventHandler:
+    """All six lifecycle hooks as no-ops; ``priority`` orders dispatch."""
+
+    priority = 0
+
     def train_begin(self, estimator, *args, **kwargs):
         pass
 
-
-class TrainEnd:
     def train_end(self, estimator, *args, **kwargs):
         pass
 
-
-class EpochBegin:
     def epoch_begin(self, estimator, *args, **kwargs):
         pass
 
-
-class EpochEnd:
     def epoch_end(self, estimator, *args, **kwargs):
         pass
 
-
-class BatchBegin:
     def batch_begin(self, estimator, *args, **kwargs):
         pass
 
-
-class BatchEnd:
     def batch_end(self, estimator, *args, **kwargs):
         pass
 
 
+# Marker subclasses kept as distinct types so user handlers can compose
+# them (``class Probe(BatchEnd, EpochEnd)``) exactly as with the reference.
+class TrainBegin(EventHandler):
+    pass
+
+
+class TrainEnd(EventHandler):
+    pass
+
+
+class EpochBegin(EventHandler):
+    pass
+
+
+class EpochEnd(EventHandler):
+    pass
+
+
+class BatchBegin(EventHandler):
+    pass
+
+
+class BatchEnd(EventHandler):
+    pass
+
+
+class _Every:
+    """Fires every ``period`` ticks (None/0 period → never fires)."""
+
+    def __init__(self, period):
+        self.period = period
+        self.count = 0
+
+    def tick(self):
+        self.count += 1
+        return bool(self.period) and self.count % self.period == 0
+
+
+class _Better:
+    """Tracks whether a monitored value improved.
+
+    ``mode``: 'min', 'max', or 'auto' (maximize iff the metric name smells
+    like an accuracy/f1/score, else minimize). ``min_delta`` is the margin a
+    new value must clear to count as improvement.
+    """
+
+    _MAXIMIZE_HINTS = ("acc", "f1", "auc", "score", "map", "recall",
+                       "precision")
+
+    def __init__(self, monitor, mode="auto", min_delta=0.0):
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto/min/max, got {mode!r}")
+        self.monitor = monitor
+        self.min_delta = min_delta
+        if mode == "auto":
+            name = monitor.get()[0] if monitor is not None else ""
+            mode = "max" if any(h in str(name).lower()
+                                for h in self._MAXIMIZE_HINTS) else "min"
+        self.maximize = mode == "max"
+        self.best = None
+
+    def value(self):
+        return self.monitor.get()[1]
+
+    @staticmethod
+    def is_nan(value):
+        try:
+            return math.isnan(float(value))
+        except (TypeError, ValueError):
+            return False
+
+    def check(self, value):
+        """Record ``value``; True when it beats the best seen so far."""
+        if value is None or self.is_nan(value):
+            return False
+        if self.best is None:
+            self.best = value
+            return True
+        if self.maximize:
+            improved = value > self.best + self.min_delta
+        else:
+            improved = value < self.best - self.min_delta
+        if improved:
+            self.best = value
+        return improved
+
+
 class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after ``max_epoch`` epochs or ``max_batch`` total batches."""
+
     def __init__(self, max_epoch=None, max_batch=None):
-        self.max_epoch = max_epoch
-        self.max_batch = max_batch
-        self.current_batch = 0
-        self.current_epoch = 0
+        self.max_epoch, self.max_batch = max_epoch, max_batch
+        self.current_epoch = self.current_batch = 0
         self.stop_training = False
 
     def train_begin(self, estimator, *args, **kwargs):
-        self.current_batch = 0
-        self.current_epoch = 0
+        self.current_epoch = self.current_batch = 0
+        self.stop_training = False
 
     def batch_end(self, estimator, *args, **kwargs):
         self.current_batch += 1
@@ -71,6 +166,9 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
 
 
 class MetricHandler(EpochBegin, BatchEnd):
+    """Reset metrics at epoch start; feed them each batch. Loss-type
+    metrics consume the loss array, the rest consume (label, pred)."""
+
     def __init__(self, metrics, priority=-1000):
         self.metrics = metrics
         self.priority = priority
@@ -80,167 +178,168 @@ class MetricHandler(EpochBegin, BatchEnd):
             m.reset()
 
     def batch_end(self, estimator, *args, **kwargs):
-        pred = kwargs.get("pred")
-        label = kwargs.get("label")
-        loss = kwargs.get("loss")
-        for m in self.metrics:
-            from ....metric import Loss as LossMetric
+        from ....metric import Loss as LossMetric
 
+        for m in self.metrics:
             if isinstance(m, LossMetric):
-                m.update(0, loss)
+                m.update(0, kwargs.get("loss"))
             else:
-                m.update(label, pred)
+                m.update(kwargs.get("label"), kwargs.get("pred"))
 
 
 class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run ``eval_fn(val_data)`` every ``epoch_period`` epochs and/or every
+    ``batch_period`` batches (mid-epoch validation for long epochs)."""
+
     def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
                  priority=-1000):
-        self.val_data = val_data
-        self.eval_fn = eval_fn
-        self.epoch_period = epoch_period
-        self.batch_period = batch_period
+        self.val_data, self.eval_fn = val_data, eval_fn
         self.priority = priority
-        self.current_batch = 0
-        self.current_epoch = 0
+        self._epochs = _Every(epoch_period)
+        self._batches = _Every(batch_period)
+
+    @property
+    def current_epoch(self):
+        return self._epochs.count
+
+    @property
+    def current_batch(self):
+        return self._batches.count
 
     def batch_end(self, estimator, *args, **kwargs):
-        self.current_batch += 1
-        if self.batch_period and self.current_batch % self.batch_period == 0:
+        if self._batches.tick():
             self.eval_fn(val_data=self.val_data)
 
     def epoch_end(self, estimator, *args, **kwargs):
-        self.current_epoch += 1
-        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+        if self._epochs.tick():
             self.eval_fn(val_data=self.val_data)
 
 
 class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
                      BatchEnd):
+    """Log per-epoch summaries, and per-batch metric lines when
+    ``log_interval`` is an int."""
+
     def __init__(self, log_interval="epoch", metrics=None, priority=-1000):
-        self.log_interval = log_interval
-        self.metrics = metrics or []
+        self.log_interval, self.metrics = log_interval, metrics or []
         self.priority = priority
-        self.batch_index = 0
-        self.current_epoch = 0
-        self.logger = logging.getLogger("mxnet_tpu.estimator")
+        self.current_epoch = self.batch_index = 0
+        self._t_train = self._t_epoch = 0.0
+
+    def _metric_line(self):
+        return " ".join(f"{n}={v:.4f}" for m in self.metrics
+                        for n, v in m.get_name_value())
 
     def train_begin(self, estimator, *args, **kwargs):
-        self.train_start = time.time()
-        self.logger.info("Training begin")
+        self._t_train = time.time()
+        _LOG.info("Training begin")
 
     def train_end(self, estimator, *args, **kwargs):
-        t = time.time() - self.train_start
-        self.logger.info("Training finished in %.1fs", t)
+        _LOG.info("Training finished in %.1fs", time.time() - self._t_train)
 
     def epoch_begin(self, estimator, *args, **kwargs):
-        self.epoch_start = time.time()
+        self._t_epoch = time.time()
 
     def epoch_end(self, estimator, *args, **kwargs):
-        msgs = [f"{n}={v:.4f}" for m in self.metrics
-                for n, v in m.get_name_value()]
-        self.logger.info("Epoch %d finished in %.1fs: %s",
-                         self.current_epoch, time.time() - self.epoch_start,
-                         " ".join(msgs))
+        _LOG.info("Epoch %d finished in %.1fs: %s", self.current_epoch,
+                  time.time() - self._t_epoch, self._metric_line())
         self.current_epoch += 1
         self.batch_index = 0
 
     def batch_end(self, estimator, *args, **kwargs):
         if isinstance(self.log_interval, int) and \
                 self.batch_index % self.log_interval == 0:
-            msgs = [f"{n}={v:.4f}" for m in self.metrics
-                    for n, v in m.get_name_value()]
-            self.logger.info("[Epoch %d][Batch %d] %s", self.current_epoch,
-                             self.batch_index, " ".join(msgs))
+            _LOG.info("[Epoch %d][Batch %d] %s", self.current_epoch,
+                      self.batch_index, self._metric_line())
         self.batch_index += 1
 
 
 class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
-    """Save params + trainer states periodically (reference:
-    event_handler.py:336)."""
+    """Periodically save net params (+ trainer states), rotating out old
+    files past ``max_checkpoints``; optionally track a ``best`` checkpoint
+    against a monitored metric."""
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
                  verbose=0, save_best=False, mode="auto", epoch_period=1,
                  batch_period=None, max_checkpoints=5,
                  resume_from_checkpoint=False):
-        self.model_dir = model_dir
-        self.model_prefix = model_prefix
+        self.model_dir, self.model_prefix = model_dir, model_prefix
+        self.save_best, self.max_checkpoints = save_best, max_checkpoints
         self.monitor = monitor
-        self.save_best = save_best
-        self.epoch_period = epoch_period
-        self.batch_period = batch_period
-        self.max_checkpoints = max_checkpoints
-        self.current_epoch = 0
-        self.current_batch = 0
-        self.best = None
-        self.mode = mode
-        self.saved = []
+        self._better = _Better(monitor, mode) if monitor is not None else None
+        self._epochs = _Every(epoch_period)
+        self._batches = _Every(batch_period)
+        self._rotation = []
+
+    @property
+    def current_epoch(self):
+        return self._epochs.count
+
+    @property
+    def current_batch(self):
+        return self._batches.count
+
+    @property
+    def best(self):
+        return self._better.best if self._better is not None else None
 
     def train_begin(self, estimator, *args, **kwargs):
         os.makedirs(self.model_dir, exist_ok=True)
 
-    def _save(self, estimator, tag):
-        prefix = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
-        estimator.net.save_parameters(prefix + ".params.npz")
+    def _write(self, estimator, tag, rotate=True):
+        stem = os.path.join(self.model_dir, f"{self.model_prefix}-{tag}")
+        estimator.net.save_parameters(stem + ".params.npz")
         if estimator.trainer is not None:
-            estimator.trainer.save_states(prefix + ".states")
-        self.saved.append(prefix)
-        while len(self.saved) > self.max_checkpoints:
-            old = self.saved.pop(0)
-            for suffix in (".params.npz", ".states"):
+            estimator.trainer.save_states(stem + ".states")
+        if not rotate:
+            return
+        self._rotation.append(stem)
+        while len(self._rotation) > self.max_checkpoints:
+            stale = self._rotation.pop(0)
+            for ext in (".params.npz", ".states"):
                 try:
-                    os.remove(old + suffix)
+                    os.remove(stale + ext)
                 except OSError:
                     pass
 
     def batch_end(self, estimator, *args, **kwargs):
-        self.current_batch += 1
-        if self.batch_period and self.current_batch % self.batch_period == 0:
-            self._save(estimator, f"batch{self.current_batch}")
+        if self._batches.tick():
+            self._write(estimator, f"batch{self._batches.count}")
 
     def epoch_end(self, estimator, *args, **kwargs):
-        self.current_epoch += 1
-        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
-            self._save(estimator, f"epoch{self.current_epoch}")
-            if self.save_best and self.monitor is not None:
-                _, value = self.monitor.get()
-                better = (self.best is None or
-                          (value < self.best if self.mode != "max"
-                           else value > self.best))
-                if better:
-                    self.best = value
-                    self._save(estimator, "best")
+        if not self._epochs.tick():
+            return
+        self._write(estimator, f"epoch{self._epochs.count}")
+        if self.save_best and self._better is not None and \
+                self._better.check(self._better.value()):
+            self._write(estimator, "best", rotate=False)
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
-    """Stop when a metric stops improving (reference: event_handler.py:614)."""
+    """Stop once the monitored metric fails to improve for ``patience``
+    consecutive epochs. With ``baseline`` set, improvement is additionally
+    measured against the baseline until it is first beaten."""
 
     def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
                  baseline=None):
-        self.monitor = monitor
-        self.min_delta = min_delta
-        self.patience = patience
-        self.mode = mode
+        self.monitor, self.patience = monitor, patience
         self.baseline = baseline
-        self.wait = 0
-        self.best = None
-        self.stopped_epoch = 0
-        self.current_epoch = 0
+        self._better = _Better(monitor, mode, min_delta)
+        if baseline is not None:
+            self._better.best = baseline
+        self.wait = self.current_epoch = self.stopped_epoch = 0
         self.stop_training = False
 
-    def _improved(self, value):
-        if self.best is None:
-            return True
-        if self.mode == "max":
-            return value > self.best + self.min_delta
-        return value < self.best - self.min_delta
+    @property
+    def best(self):
+        return self._better.best
 
     def epoch_end(self, estimator, *args, **kwargs):
-        _, value = self.monitor.get()
-        if onp.isnan(value):
+        value = self._better.value()
+        if _Better.is_nan(value):
             self.current_epoch += 1
             return self.stop_training
-        if self._improved(value):
-            self.best = value
+        if self._better.check(value):
             self.wait = 0
         else:
             self.wait += 1
@@ -252,22 +351,22 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
 
     def train_end(self, estimator, *args, **kwargs):
         if self.stopped_epoch > 0:
-            logging.getLogger("mxnet_tpu.estimator").info(
-                "Early stopping at epoch %d", self.stopped_epoch)
+            _LOG.info("Early stopping at epoch %d", self.stopped_epoch)
 
 
 class GradientUpdateHandler(BatchEnd):
-    """Runs trainer.step at batch_end with the highest priority, so user
-    handlers observing gradients run before the update (reference:
-    event_handler.py GradientUpdateHandler)."""
+    """Applies ``trainer.step`` at batch_end with the most-negative default
+    priority, so handlers that must observe raw gradients before the update
+    declare a priority below -2000."""
 
     def __init__(self, priority=-2000):
         self.priority = priority
 
     def batch_end(self, estimator, *args, **kwargs):
-        if estimator.trainer is not None:
-            bs = kwargs.get("batch_size")
-            if bs is None:
-                loss = kwargs.get("loss")
-                bs = loss.shape[0] if getattr(loss, "ndim", 0) else 1
-            estimator.trainer.step(bs)
+        if estimator.trainer is None:
+            return
+        size = kwargs.get("batch_size")
+        if size is None:
+            loss = kwargs.get("loss")
+            size = loss.shape[0] if getattr(loss, "ndim", 0) else 1
+        estimator.trainer.step(size)

@@ -1,47 +1,115 @@
-"""Datasets (reference: python/mxnet/gluon/data/dataset.py)."""
+"""Dataset containers and combinators for ``gluon.data``.
+
+API parity with the reference dataset module (reference:
+python/mxnet/gluon/data/dataset.py) with one structural difference: every
+combinator (``shard``/``take``/``sample``/``transform``) returns a lazy
+*view* built on a single ``_IndexView``/``_MappedView`` pair instead of
+eagerly materializing a python list, so sharding a disk-backed ImageRecord
+dataset across data-parallel workers touches no sample until the loader
+asks for it. ``transform(..., lazy=False)`` opts into eager
+materialization (the reference contract for transforms that must run
+exactly once, e.g. random-free normalization of a small table).
+"""
 from __future__ import annotations
 
 from ...base import MXNetError
-from ...ndarray.ndarray import NDArray
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset"]
 
 
 class Dataset:
+    """Random-access collection: ``__getitem__`` + ``__len__``.
+
+    Samples flow host-side (numpy) through the data pipeline; batches are
+    transferred to device once, post-collation, by the DataLoader.
+    """
+
     def __getitem__(self, idx):
         raise NotImplementedError
 
     def __len__(self):
         raise NotImplementedError
 
+    # -- combinators (all lazy unless stated) -------------------------------
     def filter(self, fn):
-        return SimpleDataset([s for s in self if fn(s)])
+        """Keep samples where ``fn(sample)``; evaluates ``fn`` eagerly once
+        (the survivor index list must be known for ``__len__``)."""
+        kept = [i for i in range(len(self)) if fn(self[i])]
+        return _IndexView(self, kept)
 
     def shard(self, num_shards, index):
+        """Contiguous 1/num_shards slice (shard ``index``) as a lazy view;
+        the first ``len % num_shards`` shards get one extra sample, so
+        shard sizes differ by at most one (shard before shuffling so each
+        data-parallel worker sees a unique subset)."""
+        if not 0 <= index < num_shards:
+            raise MXNetError(
+                f"shard index {index} out of range for {num_shards} shards")
         n = len(self)
-        per = (n + num_shards - 1) // num_shards
-        return SimpleDataset([self[i] for i in
-                              range(index * per, min(n, (index + 1) * per))])
+        base, extra = divmod(n, num_shards)
+        lo = base * index + min(index, extra)
+        hi = lo + base + (1 if index < extra else 0)
+        return _IndexView(self, range(lo, hi))
 
     def take(self, count):
-        return SimpleDataset([self[i] for i in range(min(count, len(self)))])
+        """First ``count`` samples as a lazy view."""
+        return _IndexView(self, range(min(count, len(self))))
 
     def sample(self, sampler):
-        return _SampledDataset(self, sampler)
+        """Reorder/subset by a Sampler's index stream (drawn once, now)."""
+        return _IndexView(self, list(sampler))
 
     def transform(self, fn, lazy=True):
-        return _LazyTransformDataset(self, fn)
+        """Apply ``fn`` to whole samples; eager when ``lazy=False``."""
+        view = _MappedView(self, fn)
+        return view if lazy else SimpleDataset([view[i]
+                                                for i in range(len(view))])
 
     def transform_first(self, fn, lazy=True):
-        def f(*sample):
-            if len(sample) == 1:
-                return fn(sample[0])
-            return (fn(sample[0]),) + sample[1:]
+        """Apply ``fn`` to the data element, passing labels through — the
+        standard augmentation hook (augment image, keep label)."""
 
-        return _LazyTransformDataset(self, f, unpack=True)
+        def first_only(sample):
+            if isinstance(sample, tuple) and len(sample) > 1:
+                return (fn(sample[0]),) + sample[1:]
+            if isinstance(sample, tuple):  # 1-tuple unwraps to a bare value
+                return fn(sample[0])
+            return fn(sample)
+
+        return self.transform(first_only, lazy=lazy)
+
+
+class _IndexView(Dataset):
+    """Lazy re-indexing of a base dataset (shard/take/sample/filter)."""
+
+    def __init__(self, base, indices):
+        self._base = base
+        self._indices = indices
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._base[self._indices[idx]]
+
+
+class _MappedView(Dataset):
+    """Lazy per-sample function application."""
+
+    def __init__(self, base, fn):
+        self._base = base
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._base)
+
+    def __getitem__(self, idx):
+        return self._fn(self._base[idx])
 
 
 class SimpleDataset(Dataset):
+    """Wrap any random-access python container as a Dataset."""
+
     def __init__(self, data):
         self._data = data
 
@@ -52,50 +120,23 @@ class SimpleDataset(Dataset):
         return self._data[idx]
 
 
-class _LazyTransformDataset(Dataset):
-    def __init__(self, dataset, fn, unpack=False):
-        self._dataset = dataset
-        self._fn = fn
-        self._unpack = unpack
-
-    def __len__(self):
-        return len(self._dataset)
-
-    def __getitem__(self, idx):
-        item = self._dataset[idx]
-        if self._unpack and isinstance(item, tuple):
-            return self._fn(*item)
-        return self._fn(item)
-
-
-class _SampledDataset(Dataset):
-    def __init__(self, dataset, sampler):
-        self._dataset = dataset
-        self._indices = list(sampler)
-
-    def __len__(self):
-        return len(self._indices)
-
-    def __getitem__(self, idx):
-        return self._dataset[self._indices[idx]]
-
-
 class ArrayDataset(Dataset):
-    """Zip of arrays/lists (reference: dataset.py ArrayDataset)."""
+    """Zip N equal-length arrays into (a[i], b[i], …) tuples; a single
+    array yields bare samples."""
 
-    def __init__(self, *args):
-        if not args:
+    def __init__(self, *arrays):
+        if not arrays:
             raise MXNetError("ArrayDataset needs at least one array")
-        self._length = len(args[0])
-        for a in args:
-            if len(a) != self._length:
-                raise MXNetError("all arrays must have the same length")
-        self._data = list(args)
+        lengths = {len(a) for a in arrays}
+        if len(lengths) != 1:
+            raise MXNetError(
+                f"all arrays must have the same length, got {sorted(lengths)}")
+        self._arrays = arrays
 
     def __len__(self):
-        return self._length
+        return len(self._arrays[0])
 
     def __getitem__(self, idx):
-        if len(self._data) == 1:
-            return self._data[0][idx]
-        return tuple(d[idx] for d in self._data)
+        if len(self._arrays) == 1:
+            return self._arrays[0][idx]
+        return tuple(a[idx] for a in self._arrays)
