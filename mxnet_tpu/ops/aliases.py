@@ -39,6 +39,16 @@ _LEGACY_CAMEL = {
     "SwapAxis": "swapaxes_legacy",     # registered in legacy_elemwise.py
     "Cast": "astype",
     "BlockGrad": "stop_gradient",
+    # spatial-warping / deformable tier (warp_ops.py)
+    "BilinearSampler": "bilinear_sampler",
+    "GridGenerator": "grid_generator",
+    "SpatialTransformer": "spatial_transformer",
+    "Correlation": "correlation",
+    "_contrib_DeformableConvolution": "deformable_convolution",
+    "_contrib_ModulatedDeformableConvolution":
+        "modulated_deformable_convolution",
+    "_contrib_PSROIPooling": "psroi_pooling",
+    "_contrib_DeformablePSROIPooling": "deformable_psroi_pooling",
 }
 
 # -- legacy underscore elemwise names (elemwise_binary_op_basic.cc etc.) ----

@@ -1080,6 +1080,15 @@ COVERED_ELSEWHERE = {
     "flash_attention": "test_attention_models.py",
     "box_nms": "test_vision_ops.py",
     "box_encode": "test_vision_ops.py",
+    # spatial-warping / deformable tier — forward+grad oracles
+    "bilinear_sampler": "test_warp_ops.py",
+    "grid_generator": "test_warp_ops.py",
+    "spatial_transformer": "test_warp_ops.py",
+    "correlation": "test_warp_ops.py",
+    "deformable_convolution": "test_warp_ops.py",
+    "modulated_deformable_convolution": "test_warp_ops.py",
+    "psroi_pooling": "test_warp_ops.py",
+    "deformable_psroi_pooling": "test_warp_ops.py",
     "contrib_quantize": "test_contrib.py",
     "quantized_fully_connected": "test_contrib.py",
     "contrib_dequantize": "test_contrib.py",
