@@ -80,7 +80,10 @@ def suspend():
 def set_variable(arr, name: str) -> SymNode:
     """Mark an NDArray as a graph input (reference: dc.set_variable)."""
     ctx = current()
-    node = SymNode(name=name)
+    # the traced input is concrete, so record its shape for
+    # shape-sensitive graph passes (e.g. attention-mask fusion)
+    node = SymNode(name=name,
+                   attr_dict={"__shape__": str(tuple(arr.shape))})
     arr._dc_sym = (node, 0)
     ctx.marked.append(arr)
     return node

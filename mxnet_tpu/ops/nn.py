@@ -547,6 +547,20 @@ def _multihead_attention(num_heads=1, dropout=0.0, causal=False, scale=None):
             from .pallas_kernels import flash_attention
 
             out = flash_attention(qh, kh, vh, s, causal)
+        elif mask[0].ndim == 4 and mask[0].shape[1] == 1 and \
+                mask[0].shape[2] == 1 and mask[0].shape[0] == B and \
+                mask[0].shape[3] == Tk and Tq == Tk:
+            # key-padding mask (B, 1, 1, Tk), constant over heads and
+            # queries: express as segment ids (valid=its mask value,
+            # padding=0) and stay on the fused flash path. Semantics match
+            # the dense-mask branch exactly: every query row attends the
+            # same valid-key set
+            from .pallas_kernels import flash_attention
+
+            seg = (mask[0].reshape(B, Tk) != 0).astype(jnp.int32)
+            out = flash_attention(qh, kh, vh, s, causal,
+                                  q_segment_ids=jnp.ones_like(seg),
+                                  kv_segment_ids=seg)
         else:
             logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
             if causal:
@@ -563,11 +577,13 @@ def _multihead_attention(num_heads=1, dropout=0.0, causal=False, scale=None):
 
 @register("flash_attention")
 def _flash_attention_op(num_heads=1, causal=False, scale=None):
-    def f(q, k, v):
+    def f(q, k, v, *segments):
         # canonical layout (B, H, T, D); rank-2/3 operands (headless
         # attention, e.g. the optimize_for rewrite of a 3-D matmul chain)
         # are lifted to 4-D and the output restored — the kernel itself is
-        # rank-4 only
+        # rank-4 only. Optional 4th/5th inputs: (B, Tq)/(B, Tk) segment
+        # ids (one id given → used for both sides), keeping padded/packed
+        # batches on the fused path
         from .pallas_kernels import flash_attention
 
         ndim = q.ndim
@@ -580,7 +596,14 @@ def _flash_attention_op(num_heads=1, causal=False, scale=None):
         else:
             raise MXNetError(
                 f"flash_attention expects rank 2-4 operands, got {ndim}")
-        out = flash_attention(qq, kk, vv, scale, causal)
+        q_seg = k_seg = None
+        if segments:
+            q_seg = segments[0]
+            k_seg = segments[1] if len(segments) > 1 else segments[0]
+            if ndim == 2:
+                q_seg, k_seg = q_seg[None], k_seg[None]
+        out = flash_attention(qq, kk, vv, scale, causal,
+                              q_segment_ids=q_seg, kv_segment_ids=k_seg)
         if ndim == 2:
             return out[0, 0]
         if ndim == 3:

@@ -55,23 +55,37 @@ def _use_pallas() -> bool:
 # ---------------------------------------------------------------------------
 # reference (XLA) attention — also the vjp recompute path
 # ---------------------------------------------------------------------------
-def _attention_reference(q, k, v, scale, causal):
+def _attention_reference(q, k, v, scale, causal, q_seg=None, k_seg=None):
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
+    masked = None
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
-        s = jnp.where(mask, s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
+        masked = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)[None, None]
+    if q_seg is not None:
+        seg = q_seg[:, None, :, None] == k_seg[:, None, None, :]
+        masked = seg if masked is None else (masked & seg)
+    if masked is None:
+        p = jax.nn.softmax(s, axis=-1)
+    else:
+        s = jnp.where(masked, s, _NEG_INF)
+        # where-masked softmax: a fully masked query row yields zeros, not
+        # a uniform distribution (matters for padded batches)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.where(masked, jnp.exp(s - m), 0.0)
+        p = e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
 # ---------------------------------------------------------------------------
 # flash attention forward kernel
 # ---------------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                      l_ref, *, scale, causal, block_q, block_k, seq_k,
-                      causal_offset=0):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q,
+                      block_k, seq_k, causal_offset=0, use_seg=False):
+    if use_seg:
+        qs_ref, ks_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qb = pl.program_id(1)
     q = q_ref[0]  # (BQ, D) — stays in input dtype so the MXU runs bf16
     num_kb = seq_k // block_k
@@ -95,9 +109,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
             ki = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qi + causal_offset >= ki, s, _NEG_INF)
+        if use_seg:
+            # tokens attend within their segment only (padding tokens get a
+            # segment id of their own, so padded keys never contribute)
+            qs = qs_ref[:].reshape(block_q, 1)
+            ks = ks_ref[0, pl.ds(kb * block_k, block_k)].reshape(1, block_k)
+            seg_ok = qs == ks
+            s = jnp.where(seg_ok, s, _NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if use_seg:
+            # zero p explicitly: _NEG_INF is finite, so a fully masked row
+            # has s == m_new and p would otherwise be 1 everywhere (output
+            # must be zeros, matching the XLA reference and the bwd kernels)
+            p = jnp.where(seg_ok, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -107,10 +133,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         return 0
 
     jax.lax.fori_loop(0, num_kb, body, 0)
-    o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+    # fully masked rows (l == 0) output zeros, not NaN
+    o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
     # log-sum-exp per query row: saved for the backward kernels, which
     # reconstruct p = exp(s - lse) without a second online-softmax pass
-    lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
+    lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 try:  # pallas imports are deferred-safe: CPU-only installs still work
@@ -123,28 +150,41 @@ except Exception:  # noqa: BLE001
 
 
 def _flash_attention_tpu(q, k, v, scale, causal, block_q, block_k,
-                         return_lse=False):
-    """q,k,v: (B, H, T, D) with T % block == 0, D % 128 == 0 (pre-padded)."""
+                         return_lse=False, q_seg=None, k_seg=None):
+    """q,k,v: (B, H, T, D) with T % block == 0, D % 128 == 0 (pre-padded).
+    q_seg/k_seg: optional (B, T) int32 segment ids."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
     qr = q.reshape(b * h, tq, d)
     kr = k.reshape(b * h, tk, d)
     vr = v.reshape(b * h, tk, d)
+    use_seg = q_seg is not None
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_k=tk,
-        causal_offset=tk - tq)
+        causal_offset=tk - tq, use_seg=use_seg)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, tk, d), lambda bh, qb: (bh, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, tk, d), lambda bh, qb: (bh, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [qr, kr, vr]
+    if use_seg:
+        # segment ids are per-batch; grid dim 0 runs over b*h fused heads
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda bh, qb: (bh // h, qb),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk), lambda bh, qb: (bh // h, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        operands += [q_seg.astype(jnp.int32), k_seg.astype(jnp.int32)]
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, tq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk, d), lambda bh, qb: (bh, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tk, d), lambda bh, qb: (bh, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
                          memory_space=pltpu.VMEM),
@@ -166,7 +206,7 @@ def _flash_attention_tpu(q, k, v, scale, causal, block_q, block_k,
             transcendentals=b * h * tq * tk,
         ),
         interpret=_interpret(),
-    )(qr, kr, vr)
+    )(*operands)
     out = out.reshape(b, h, tq, d)
     if return_lse:
         return out, lse.reshape(b, h, tq, 1)
@@ -183,13 +223,38 @@ def _pad_to(x, axis, multiple):
     return jnp.pad(x, pad), size
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, scale=None, causal=False):
+def flash_attention(q, k, v, scale=None, causal=False, q_segment_ids=None,
+                    kv_segment_ids=None):
     """Fused attention over (B, H, T, D) operands.
 
     Pallas online-softmax kernel on TPU; identical XLA math elsewhere.
+    ``q_segment_ids``/``kv_segment_ids`` are optional (B, T) int arrays:
+    tokens attend only within matching segment ids, which covers BERT
+    key-padding masks (valid tokens id 1, padding id 0) and packed
+    sequences — without materializing an O(T²) mask.
     """
+    if q_segment_ids is None and kv_segment_ids is None:
+        return _flash_attention_plain(q, k, v, scale, causal)
+    if kv_segment_ids is None:
+        kv_segment_ids = q_segment_ids
+    if q_segment_ids is None:
+        q_segment_ids = kv_segment_ids
+    return _flash_attention_seg(q, k, v,
+                                q_segment_ids.astype(jnp.int32),
+                                kv_segment_ids.astype(jnp.int32),
+                                scale, causal)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention_plain(q, k, v, scale=None, causal=False):
     return _flash_attention_impl(q, k, v, scale, causal)
+
+
+def _blocks_ok(q, k):
+    bq = min(DEFAULT_BLOCK_Q, q.shape[2])
+    bk = min(DEFAULT_BLOCK_K, k.shape[2])
+    ok = q.shape[2] % bq == 0 and k.shape[2] % bk == 0
+    return bq, bk, ok
 
 
 def _flash_attention_impl(q, k, v, scale, causal):
@@ -199,9 +264,8 @@ def _flash_attention_impl(q, k, v, scale, causal):
         return _attention_reference(q, k, v, s, causal)
     # head_dim needs no padding (Mosaic handles sub-lane widths); the seq
     # axes must tile evenly by the block sizes
-    bq = min(DEFAULT_BLOCK_Q, q.shape[2])
-    bk = min(DEFAULT_BLOCK_K, k.shape[2])
-    if q.shape[2] % bq != 0 or k.shape[2] % bk != 0:
+    bq, bk, ok = _blocks_ok(q, k)
+    if not ok:
         # ragged shapes: padded KV rows would need an extra mask; the
         # reference path is simplest-correct there
         return _attention_reference(q, k, v, s, causal)
@@ -211,13 +275,63 @@ def _flash_attention_impl(q, k, v, scale, causal):
 def _flash_fwd(q, k, v, scale, causal):
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
-    bq = min(DEFAULT_BLOCK_Q, q.shape[2])
-    bk = min(DEFAULT_BLOCK_K, k.shape[2])
-    if _use_pallas() and q.shape[2] % bq == 0 and k.shape[2] % bk == 0:
+    bq, bk, ok = _blocks_ok(q, k)
+    if _use_pallas() and ok:
         out, lse = _flash_attention_tpu(q, k, v, s, causal, bq, bk,
                                         return_lse=True)
         return out, (q, k, v, out, lse)
     return _attention_reference(q, k, v, s, causal), (q, k, v, None, None)
+
+
+# -- segment-ids (key padding / packed sequences) variant -------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _flash_attention_seg(q, k, v, q_seg, k_seg, scale, causal):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    if not _use_pallas():
+        return _attention_reference(q, k, v, s, causal, q_seg, k_seg)
+    bq, bk, ok = _blocks_ok(q, k)
+    if not ok:
+        return _attention_reference(q, k, v, s, causal, q_seg, k_seg)
+    return _flash_attention_tpu(q, k, v, s, causal, bq, bk,
+                                q_seg=q_seg, k_seg=k_seg)
+
+
+def _flash_seg_fwd(q, k, v, q_seg, k_seg, scale, causal):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    bq, bk, ok = _blocks_ok(q, k)
+    if _use_pallas() and ok:
+        out, lse = _flash_attention_tpu(q, k, v, s, causal, bq, bk,
+                                        return_lse=True,
+                                        q_seg=q_seg, k_seg=k_seg)
+        return out, (q, k, v, q_seg, k_seg, out, lse)
+    out = _attention_reference(q, k, v, s, causal, q_seg, k_seg)
+    return out, (q, k, v, q_seg, k_seg, None, None)
+
+
+def _flash_seg_bwd(scale, causal, res, g):
+    import numpy as onp
+
+    q, k, v, q_seg, k_seg, out, lse = res
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    if lse is not None and _use_pallas():
+        bq, bk, ok = _blocks_ok(q, k)
+        if ok:
+            dq, dk, dv = _flash_bwd_tpu(q, k, v, out, lse, g, s, causal,
+                                        bq, bk, q_seg=q_seg, k_seg=k_seg)
+            return (dq, dk, dv,
+                    onp.zeros(q_seg.shape, jax.dtypes.float0),
+                    onp.zeros(k_seg.shape, jax.dtypes.float0))
+    dq, dk, dv = _attention_bwd_blockwise(q, k, v, g, s, causal,
+                                          q_seg=q_seg, k_seg=k_seg)
+    return (dq, dk, dv,
+            onp.zeros(q_seg.shape, jax.dtypes.float0),
+            onp.zeros(k_seg.shape, jax.dtypes.float0))
+
+
+_flash_attention_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -233,8 +347,12 @@ def _flash_fwd(q, k, v, scale, causal):
 # No O(T²) materialization; accumulation in fp32 VMEM scratch.
 # ---------------------------------------------------------------------------
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                          block_q, block_k, seq_q, causal_offset):
+                          *rest, scale, causal, block_q, block_k, seq_q,
+                          causal_offset, use_seg=False):
+    if use_seg:
+        qs_ref, ks_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     kb = pl.program_id(1)
     k = k_ref[0]  # (BK, D)
     v = v_ref[0]
@@ -258,6 +376,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qi + causal_offset >= ki, s, _NEG_INF)
         p = jnp.exp(s - lse)                                  # normalized
+        if use_seg:
+            # mask p itself: for a fully masked row lse was clamped, so
+            # exp(s - lse) is not reliably ~0 there
+            qs = qs_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
+            ks = ks_ref[:].reshape(1, block_k)
+            p = jnp.where(qs == ks, p, 0.0)
         gf = g.astype(jnp.float32)
         dv_acc[:] += jax.lax.dot_general(
             p, gf, (((0,), (0,)), ((), ())),
@@ -277,8 +401,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                         dq_ref, dq_acc, *, scale, causal, block_q,
-                         block_k, seq_k, causal_offset):
+                         *rest, scale, causal, block_q,
+                         block_k, seq_k, causal_offset, use_seg=False):
+    if use_seg:
+        qs_ref, ks_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
     qb = pl.program_id(1)
     q = q_ref[0]   # (BQ, D)
     g = g_ref[0]
@@ -302,6 +430,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qi + causal_offset >= ki, s, _NEG_INF)
         p = jnp.exp(s - lse)
+        if use_seg:
+            qs = qs_ref[:].reshape(block_q, 1)
+            ks = ks_ref[0, pl.ds(kb * block_k, block_k)].reshape(1, block_k)
+            p = jnp.where(qs == ks, p, 0.0)
         dp = jax.lax.dot_general(
             gf, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -315,7 +447,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_tpu(q, k, v, out, lse, g, scale, causal, block_q, block_k):
+def _flash_bwd_tpu(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+                   q_seg=None, k_seg=None):
     b, h, tq, d = q.shape
     tk = k.shape[2]
     qr = q.reshape(b * h, tq, d)
@@ -326,6 +459,10 @@ def _flash_bwd_tpu(q, k, v, out, lse, g, scale, causal, block_q, block_k):
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True).reshape(b * h, tq, 1)
     off = tk - tq
+    use_seg = q_seg is not None
+    if use_seg:
+        q_seg = q_seg.astype(jnp.int32)
+        k_seg = k_seg.astype(jnp.int32)
 
     full_q = pl.BlockSpec((1, tq, d), lambda bh, blk: (bh, 0, 0),
                           memory_space=pltpu.VMEM)
@@ -335,12 +472,22 @@ def _flash_bwd_tpu(q, k, v, out, lse, g, scale, causal, block_q, block_k):
                              memory_space=pltpu.VMEM)
     kv_blk = pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0),
                           memory_space=pltpu.VMEM)
+    dkv_in_specs = [full_q, kv_blk, kv_blk, full_q, full_stat, full_stat]
+    dkv_operands = [qr, kr, vr, gr, lser, delta]
+    if use_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, tq), lambda bh, kb: (bh // h, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k), lambda bh, kb: (bh // h, kb),
+                         memory_space=pltpu.VMEM),
+        ]
+        dkv_operands += [q_seg, k_seg]
     dkv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=tq,
-                          causal_offset=off),
+                          causal_offset=off, use_seg=use_seg),
         grid=(b * h, tk // block_k),
-        in_specs=[full_q, kv_blk, kv_blk, full_q, full_stat, full_stat],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh, kb: (bh, kb, 0),
                          memory_space=pltpu.VMEM),
@@ -361,25 +508,35 @@ def _flash_bwd_tpu(q, k, v, out, lse, g, scale, causal, block_q, block_k):
             transcendentals=b * h * tq * tk,
         ),
         interpret=_interpret(),
-    )(qr, kr, vr, gr, lser, delta)
+    )(*dkv_operands)
     dk, dv = dkv
 
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
+                     memory_space=pltpu.VMEM),
+        full_k, full_k,
+        pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    dq_operands = [qr, kr, vr, gr, lser, delta]
+    if use_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q), lambda bh, qb: (bh // h, qb),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tk), lambda bh, qb: (bh // h, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+        dq_operands += [q_seg, k_seg]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_k=tk,
-                          causal_offset=off),
+                          causal_offset=off, use_seg=use_seg),
         grid=(b * h, tq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
-                         memory_space=pltpu.VMEM),
-            full_k, full_k,
-            pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda bh, qb: (bh, qb, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qb: (bh, qb, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
@@ -390,7 +547,7 @@ def _flash_bwd_tpu(q, k, v, out, lse, g, scale, causal, block_q, block_k):
             transcendentals=b * h * tq * tk,
         ),
         interpret=_interpret(),
-    )(qr, kr, vr, gr, lser, delta)
+    )(*dq_operands)
     return (dq.reshape(b, h, tq, d), dk.reshape(b, h, tk, d),
             dv.reshape(b, h, tk, d))
 
@@ -398,7 +555,8 @@ def _flash_bwd_tpu(q, k, v, out, lse, g, scale, causal, block_q, block_k):
 _BWD_BLOCK = 512
 
 
-def _attention_bwd_blockwise(q, k, v, g, scale, causal):
+def _attention_bwd_blockwise(q, k, v, g, scale, causal, q_seg=None,
+                             k_seg=None):
     """Memory-capped attention backward: recompute scores blockwise over KV.
 
     Standard flash-attention backward structure without a hand-written
@@ -418,7 +576,8 @@ def _attention_bwd_blockwise(q, k, v, g, scale, causal):
         blk = 1  # prime-ish huge tk: still capped, just slower
     elif blk < 16:
         _, vjp = jax.vjp(lambda q_, k_, v_:
-                         _attention_reference(q_, k_, v_, scale, causal),
+                         _attention_reference(q_, k_, v_, scale, causal,
+                                              q_seg, k_seg),
                          q, k, v)
         return vjp(g)
     nblk = tk // blk
@@ -428,11 +587,16 @@ def _attention_bwd_blockwise(q, k, v, g, scale, causal):
     vb = v.reshape(b, h, nblk, blk, d).transpose(2, 0, 1, 3, 4)
 
     def mask_for(idx):
-        if not causal:
-            return None
-        qi = jnp.arange(tq)[:, None] + (tk - tq)
-        ki = idx * blk + jnp.arange(blk)[None, :]
-        return (qi >= ki)[None, None]
+        m = None
+        if causal:
+            qi = jnp.arange(tq)[:, None] + (tk - tq)
+            ki = idx * blk + jnp.arange(blk)[None, :]
+            m = (qi >= ki)[None, None]
+        if q_seg is not None:
+            ks_i = lax.dynamic_slice_in_dim(k_seg, idx * blk, blk, axis=1)
+            seg = q_seg[:, None, :, None] == ks_i[:, None, None, :]
+            m = seg if m is None else (m & seg)
+        return m
 
     # pass 1: softmax stats (row max m, denominator l) + output recompute
     def stats_step(carry, inputs):
@@ -445,6 +609,8 @@ def _attention_bwd_blockwise(q, k, v, g, scale, causal):
             s = jnp.where(msk, s, _NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if msk is not None:
+            p = jnp.where(msk, p, 0.0)  # fully masked rows: l stays 0
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
@@ -471,6 +637,8 @@ def _attention_bwd_blockwise(q, k, v, g, scale, causal):
         if msk is not None:
             s = jnp.where(msk, s, _NEG_INF)
         p = jnp.exp(s - m) / jnp.maximum(l, 1e-30)  # (b,h,q,blk)
+        if msk is not None:
+            p = jnp.where(msk, p, 0.0)
         dv_i = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
         dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
         ds = p * (dp - delta) * scale
@@ -497,7 +665,7 @@ def _flash_bwd(scale, causal, res, g):
     return _attention_bwd_blockwise(q, k, v, g, s, causal)
 
 
-flash_attention.defvjp(_flash_fwd, _flash_bwd)
+_flash_attention_plain.defvjp(_flash_fwd, _flash_bwd)
 
 
 # ---------------------------------------------------------------------------

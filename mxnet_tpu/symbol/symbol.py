@@ -327,6 +327,10 @@ def var(name=None, shape=None, dtype=None, **kw):
 
     name = NameManager.current().get(name, "var")
     attrs = AttrScope.current().get({k: str(v) for k, v in kw.items()})
+    if shape is not None:
+        # recorded for shape-sensitive graph passes (e.g. the attention
+        # fusion pass verifying a mask is a key-padding mask)
+        attrs["__shape__"] = str(tuple(shape))
     return Symbol([(SymNode(name=name, attr_dict=attrs), 0)])
 
 

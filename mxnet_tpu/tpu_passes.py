@@ -101,9 +101,44 @@ def _match_qk(node):
     return None
 
 
+def _entry_shape(entry):
+    """Static shape of a graph entry when known: const value shape, or a
+    var's recorded ``shape=`` from ``sym.var`` — else None."""
+    if isinstance(entry, Literal):
+        return None
+    node, _ = entry
+    if node.is_const:
+        return tuple(node.value.shape)
+    ann = node.attr_dict.get("__shape__")
+    if ann:
+        try:
+            return tuple(int(x) for x in ann.strip("()").split(",") if x)
+        except ValueError:
+            return None
+    return None
+
+
+def _match_key_padding_mask(node, counts):
+    """Match ``where(mask, logits, big_negative)`` where mask is statically
+    known to be a (B, 1, 1, Tk) key-padding mask. Returns
+    (logits_node, mask_entry) or None."""
+    if _op_name(node) not in ("where", "_npi_where") or \
+            counts.get(id(node), 0) != 1:
+        return None
+    cond_e, x_e, y_e = node.inputs
+    neg = _scalar_of(y_e)
+    if neg is None or neg > -1e9 or isinstance(x_e, Literal):
+        return None
+    shape = _entry_shape(cond_e)
+    if shape is None or len(shape) != 4 or shape[1] != 1 or shape[2] != 1:
+        return None
+    return x_e[0], cond_e, shape
+
+
 def _match_attention(out_node, counts):
-    """Match out_node = matmul(softmax(scale(q·kᵀ)), v). Returns
-    (q_entry, k_entry, v_entry, scale) or None."""
+    """Match out_node = matmul(softmax([mask](scale(q·kᵀ))), v). Returns
+    (q_entry, k_entry, v_entry, scale, mask_entry_or_None, mask_shape)
+    or None."""
     name = _op_name(out_node)
     if name == "matmul":
         w_e, v_e = out_node.inputs[0], out_node.inputs[1]
@@ -126,6 +161,11 @@ def _match_attention(out_node, counts):
     if isinstance(s_e, Literal):
         return None
     s_node, _ = s_e
+    # optional key-padding mask: softmax(where(mask, logits, -big))
+    mask_e = mask_shape = None
+    masked = _match_key_padding_mask(s_node, counts)
+    if masked is not None:
+        s_node, mask_e, mask_shape = masked
     scale_mult = 1.0
     logits = s_node
     # optional explicit scaling of the logits
@@ -147,13 +187,17 @@ def _match_attention(out_node, counts):
     if qk is None:
         return None
     q_e, k_e, q_scale = qk
-    return q_e, k_e, v_e, scale_mult * q_scale
+    return q_e, k_e, v_e, scale_mult * q_scale, mask_e, mask_shape
 
 
 @register_pass("tpu")
 def fuse_attention(sym: Symbol) -> Symbol:
-    """Rewrite eligible attention subgraphs onto ``flash_attention``."""
+    """Rewrite eligible attention subgraphs onto ``flash_attention`` —
+    including the key-padding-masked form, whose (B, 1, 1, Tk) mask is
+    lowered to segment ids (query side all-valid, key side the mask) so
+    padded batches stay on the fused kernel."""
     from .ops.registry import get_op
+    from .symbol.symbol import SymNode
 
     nodes = topo_sort(sym._entries)
     counts = _consumer_counts(nodes, sym._entries)
@@ -162,10 +206,30 @@ def fuse_attention(sym: Symbol) -> Symbol:
         m = _match_attention(node, counts)
         if m is None:
             continue
-        q_e, k_e, v_e, scale = m
+        q_e, k_e, v_e, scale, mask_e, mask_shape = m
+        inputs = (q_e, k_e, v_e)
+        if mask_e is not None:
+            b, _, _, tk = mask_shape
+            # only rewrite when q/k shapes are statically known to be
+            # compatible: self-attention (Tq == Tk == mask Tk, same batch).
+            # Cross-attention padding masks (Tq != Tk) would build segment
+            # ids of the wrong length — leave those graphs alone
+            q_shape, k_shape = _entry_shape(q_e), _entry_shape(k_e)
+            if (q_shape is None or k_shape is None or len(q_shape) < 2 or
+                    q_shape[-2] != tk or k_shape[-2] != tk or
+                    q_shape[0] != b):
+                continue
+            # normalize truthiness to 0/1 ids the way where() would
+            # (any nonzero mask value means "keep")
+            flat = SymNode(op=get_op("reshape"),
+                           attrs={"newshape": (b, tk)}, inputs=(mask_e,))
+            k_seg = SymNode(op=get_op("not_equal"),
+                            inputs=((flat, 0), Literal(0)))
+            q_seg = SymNode(op=get_op("ones_like"), inputs=((k_seg, 0),))
+            inputs = (q_e, k_e, v_e, (q_seg, 0), (k_seg, 0))
         # rewrite the head node in place: downstream (SymNode, idx)
         # references — including graph outputs — stay valid
         node.op = flash
         node.attrs = {"scale": scale, "causal": False}
-        node.inputs = (q_e, k_e, v_e)
+        node.inputs = inputs
     return sym

@@ -138,3 +138,82 @@ def test_rank3_headless_attention_fuses_and_runs():
     assert_almost_equal(got, want, rtol=2e-3, atol=2e-4)
     (cop, _, _), = net._cached.values()
     assert _flash_count(cop.sym) == 1
+
+
+class _MaskedAttention(mx.gluon.HybridBlock):
+    """Attention with an explicit key-padding where-mask — the masked
+    pattern the pass must lower to segment ids."""
+
+    def forward(self, q, k, v, mask):
+        kt = np.swapaxes(k, -1, -2)
+        logits = np.matmul(q, kt) / (q.shape[-1] ** 0.5)
+        logits = np.where(mask, logits, np.array(-1e30, dtype="float32"))
+        w = npx.softmax(logits, axis=-1)
+        return np.matmul(w, v)
+
+
+def test_masked_attention_pattern_rewritten():
+    """softmax(where(padding_mask, logits, -big)) fuses onto
+    flash_attention with segment-id inputs; padded numerics preserved."""
+    B, H, T, D = 2, 2, 8, 4
+    rng = onp.random.RandomState(7)
+    q = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+    k = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+    v = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+    valid = onp.ones((B, 1, 1, T), onp.float32)
+    valid[0, :, :, 5:] = 0  # batch row 0: last 3 keys padded
+    mask = np.array(valid)
+
+    net = _MaskedAttention()
+    want = net(q, k, v, mask).asnumpy()
+
+    net.optimize_for(q, k, v, mask, backend="tpu")
+    got = net(q, k, v, mask).asnumpy()
+    (cop, _, _), = net._cached.values()
+    assert _flash_count(cop.sym) == 1, \
+        [n.op.name for n in topo_sort(cop.sym._entries) if n.op]
+    # the fused node carries the two segment-id inputs
+    (head,) = [n for n in topo_sort(cop.sym._entries)
+               if n.op is not None and n.op.name == "flash_attention"]
+    assert len(head.inputs) == 5
+    # valid (unpadded) query rows must match exactly; padded-query rows are
+    # garbage under both schemes and excluded
+    assert_almost_equal(got[:, :, :5], want[:, :, :5], rtol=2e-3, atol=2e-4)
+    assert_almost_equal(got[1], want[1], rtol=2e-3, atol=2e-4)
+
+
+def test_masked_attention_not_rewritten_for_full_masks():
+    """A (B, 1, Tq, Tk) mask is NOT a pure key-padding mask — the pass must
+    leave the graph alone rather than change semantics."""
+    B, H, T, D = 1, 1, 4, 4
+    rng = onp.random.RandomState(8)
+    q = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+    k = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+    v = np.array(rng.randn(B, H, T, D).astype(onp.float32))
+    mask = np.array(onp.tril(onp.ones((T, T), onp.float32))
+                    .reshape(B, 1, T, T))
+    net = _MaskedAttention()
+    want = net(q, k, v, mask).asnumpy()
+    net.optimize_for(q, k, v, mask, backend="tpu")
+    got = net(q, k, v, mask).asnumpy()
+    (cop, _, _), = net._cached.values()
+    assert _flash_count(cop.sym) == 0
+    assert_almost_equal(got, want, rtol=2e-3, atol=2e-4)
+
+
+def test_masked_cross_attention_not_rewritten():
+    """A (B,1,1,Tk) mask over CROSS-attention (Tq != Tk) must not be
+    rewritten — segment ids of length Tk cannot describe the query side."""
+    B, H, Tq, Tk, D = 1, 1, 4, 8, 4
+    rng = onp.random.RandomState(9)
+    q = np.array(rng.randn(B, H, Tq, D).astype(onp.float32))
+    k = np.array(rng.randn(B, H, Tk, D).astype(onp.float32))
+    v = np.array(rng.randn(B, H, Tk, D).astype(onp.float32))
+    mask = np.array(onp.ones((B, 1, 1, Tk), onp.float32))
+    net = _MaskedAttention()
+    want = net(q, k, v, mask).asnumpy()
+    net.optimize_for(q, k, v, mask, backend="tpu")
+    got = net(q, k, v, mask).asnumpy()
+    (cop, _, _), = net._cached.values()
+    assert _flash_count(cop.sym) == 0
+    assert_almost_equal(got, want, rtol=2e-3, atol=2e-4)
