@@ -209,17 +209,22 @@ class CSVIter(DataIter):
 
 class LibSVMIter(DataIter):
     """LibSVM sparse reader (reference: src/io/iter_libsvm.cc): rows are
-    ``label idx:val ...``; batches come out as dense (batch, num_features)
-    slices of the CSR matrix plus the label vector. Only one batch is ever
-    densified at a time (the file is libsvm BECAUSE the data is sparse —
-    the full dense matrix may not fit in host memory); dense static-shape
-    batches are the TPU-correct form that feeds the MXU. The CSR triple
-    stays on host and is available via the ``csr`` attribute."""
+    ``label idx:val ...``.
+
+    Two batch forms:
+    - default: dense (batch, num_features) slices — only one batch is ever
+      densified at a time (the file is libsvm BECAUSE the data is sparse);
+      static-shape dense batches feed the MXU directly.
+    - ``sparse=True``: device ``CSRNDArray`` batches that feed
+      ``mx.nd.sparse.dot`` — the matrix is never densified (matching the
+      reference iterator's csr batches).
+    The full parsed CSR triple is available via the ``csr`` attribute."""
 
     def __init__(self, data_libsvm, data_shape, batch_size=1,
-                 last_batch_handle="pad", **kwargs):
+                 last_batch_handle="pad", sparse=False, **kwargs):
         from ._textparse import parse_libsvm
 
+        self._sparse = sparse
         labels, indptr, indices, values = parse_libsvm(str(data_libsvm))
         self._labels = labels
         self._indptr = indptr
@@ -237,17 +242,23 @@ class LibSVMIter(DataIter):
     def csr(self):
         return self._indptr, self._indices, self._values
 
-    def _dense_rows(self, rows):
-        out = onp.zeros((len(rows), self._num_feat), "float32")
-        ip, ix, vs = self._indptr, self._indices, self._values
+    def _row_entries(self, rows):
+        """(batch_row_ids, entry_ids) for the stored entries of ``rows``,
+        with features >= num_feat dropped (shared by the dense and sparse
+        batch builders so both see identical data)."""
+        ip, ix = self._indptr, self._indices
         counts = ip[rows + 1] - ip[rows]
-        flat_r = onp.repeat(onp.arange(len(rows)), counts)
         flat_i = onp.concatenate(
             [onp.arange(ip[r], ip[r + 1]) for r in rows]) if len(rows) \
             else onp.zeros(0, "int64")
-        cols = ix[flat_i]
-        keep = cols < self._num_feat
-        out[flat_r[keep], cols[keep]] = vs[flat_i][keep]
+        flat_r = onp.repeat(onp.arange(len(rows)), counts)
+        keep = ix[flat_i] < self._num_feat
+        return flat_r[keep], flat_i[keep]
+
+    def _dense_rows(self, rows):
+        out = onp.zeros((len(rows), self._num_feat), "float32")
+        flat_r, flat_i = self._row_entries(rows)
+        out[flat_r, self._indices[flat_i]] = self._values[flat_i]
         return out
 
     def __next__(self):
@@ -263,9 +274,24 @@ class LibSVMIter(DataIter):
         if pad:  # wrap around (reference "pad" semantics)
             idx = onp.concatenate([idx, onp.arange(pad)])
         self._cursor += self.batch_size
-        data = NDArray(self._dense_rows(idx))
+        if self._sparse:
+            data = self._csr_rows(idx)
+        else:
+            data = NDArray(self._dense_rows(idx))
         label = NDArray(self._labels[idx])
         return DataBatch(data=[data], label=[label], pad=pad)
+
+    def _csr_rows(self, rows):
+        """Device CSRNDArray batch (sparse=True)."""
+        from ..ndarray.sparse import CSRNDArray
+
+        flat_r, flat_i = self._row_entries(rows)
+        counts = onp.bincount(flat_r, minlength=len(rows))
+        indptr = onp.zeros(len(rows) + 1, "int64")
+        onp.cumsum(counts, out=indptr[1:])
+        return CSRNDArray(self._values[flat_i].astype("float32"),
+                          self._indices[flat_i], indptr,
+                          (len(rows), self._num_feat))
 
     def reset(self):
         self._cursor = 0

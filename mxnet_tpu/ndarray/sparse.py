@@ -8,9 +8,13 @@ reality (SURVEY §7 hard parts): XLA has no sparse tensor support, so
   optimizer updates — is implemented natively as (indices, values) pairs with
   gather/scatter lowering: dense conversion is one scatter, retain/update are
   gathers. These map cleanly onto the MXU-adjacent scatter units.
-- CSR is a host-resident format for data interchange (the reference's main
-  CSR consumer is LibSVM-style input pipelines): matrix-vector products
-  convert through dense on device, documented as such.
+- CSR is DEVICE-RESIDENT: the (values, indices, indptr) triple lives in HBM
+  as dense jax arrays (static nnz), and SpMV/SpMM runs on device as
+  gather × multiply → ``segment_sum`` over precomputed row ids (the
+  ``dot_csr`` op, matching src/operator/tensor/dot.cc CSR forward). This is
+  the XLA-native sparse formulation: no dynamic shapes, autodiff gives the
+  dense-side gradient for free, and a LibSVM pipeline can train a sparse
+  linear model without densifying the matrix.
 """
 from __future__ import annotations
 
@@ -20,7 +24,7 @@ from ..base import MXNetError
 from .ndarray import NDArray
 
 __all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix",
-           "row_sparse_array", "BaseSparseNDArray"]
+           "row_sparse_array", "BaseSparseNDArray", "dot"]
 
 
 class BaseSparseNDArray:
@@ -36,13 +40,28 @@ class BaseSparseNDArray:
 
 
 class CSRNDArray(BaseSparseNDArray):
-    """Compressed sparse row matrix (reference: sparse.py:301)."""
+    """Compressed sparse row matrix, device-resident (reference:
+    sparse.py:301 over src/operator/tensor/dot.cc CSR kernels).
+
+    ``data``/``indices``/``indptr`` are NDArrays over HBM buffers; ``nnz``
+    is static, so every operation compiles to fixed shapes. Matrix products
+    run on device (``.dot``); gradients w.r.t. the dense operand flow
+    through autograd.
+    """
 
     def __init__(self, data, indices, indptr, shape):
-        self.data = onp.asarray(data)
-        self.indices = onp.asarray(indices, dtype=onp.int64)
-        self.indptr = onp.asarray(indptr, dtype=onp.int64)
-        self._shape = tuple(shape)
+        import jax.numpy as jnp
+
+        def nd(x, dtype=None):
+            if isinstance(x, NDArray):
+                x = x._data
+            return NDArray(jnp.asarray(x, dtype=dtype))
+
+        self.data = nd(data)
+        self.indices = nd(indices, jnp.int32)
+        self.indptr = nd(indptr, jnp.int32)
+        self._shape = tuple(int(s) for s in shape)
+        self._row_ids = None  # lazily expanded from indptr
 
     @property
     def stype(self):
@@ -58,13 +77,25 @@ class CSRNDArray(BaseSparseNDArray):
 
     @property
     def nnz(self):
-        return len(self.data)
+        return int(self.data.shape[0])
+
+    def _rows(self) -> NDArray:
+        """Per-entry row ids (nnz,) expanded from indptr once, on device."""
+        if self._row_ids is None:
+            import jax.numpy as jnp
+
+            counts = jnp.diff(self.indptr._data)
+            self._row_ids = NDArray(jnp.repeat(
+                jnp.arange(self._shape[0], dtype=jnp.int32), counts,
+                total_repeat_length=self.nnz))
+        return self._row_ids
 
     def todense(self) -> NDArray:
-        out = onp.zeros(self._shape, dtype=self.data.dtype)
-        for row in range(self._shape[0]):
-            lo, hi = self.indptr[row], self.indptr[row + 1]
-            out[row, self.indices[lo:hi]] = self.data[lo:hi]
+        import jax.numpy as jnp
+
+        out = jnp.zeros(self._shape, self.data._data.dtype)
+        out = out.at[self._rows()._data, self.indices._data].set(
+            self.data._data, mode="drop")
         return NDArray(out)
 
     def tostype(self, stype):
@@ -74,15 +105,17 @@ class CSRNDArray(BaseSparseNDArray):
             return self
         raise MXNetError(f"cannot convert csr to {stype}")
 
-    def dot(self, other):
-        """SpMV/SpMM via dense on device (no native XLA sparse)."""
-        dense = self.todense()
-        return dense.dot(other)
+    def dot(self, other, transpose_a=False):
+        """Device SpMV/SpMM: gather × multiply → segment_sum (dot_csr op).
+        ``transpose_a`` computes Aᵀ·other without materializing Aᵀ."""
+        return dot(self, other, transpose_a=transpose_a)
 
     def slice(self, start, stop):
-        lo, hi = self.indptr[start], self.indptr[stop]
-        indptr = self.indptr[start:stop + 1] - self.indptr[start]
-        return CSRNDArray(self.data[lo:hi], self.indices[lo:hi], indptr,
+        lo = int(self.indptr._data[start])
+        hi = int(self.indptr._data[stop])
+        return CSRNDArray(self.data._data[lo:hi],
+                          self.indices._data[lo:hi],
+                          self.indptr._data[start:stop + 1] - lo,
                           (stop - start, self._shape[1]))
 
     def __getitem__(self, key):
@@ -96,6 +129,23 @@ class CSRNDArray(BaseSparseNDArray):
     def __repr__(self):
         return (f"<CSRNDArray {self._shape} nnz={self.nnz} "
                 f"dtype={self.dtype}>")
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """``mx.nd.sparse.dot`` (reference: python/mxnet/ndarray/sparse.py dot
+    over src/operator/tensor/dot.cc): CSR × dense on device.
+
+    Routed through the registered ``dot_csr`` op so autograd records the
+    product and the dense operand receives gradients.
+    """
+    if not isinstance(lhs, CSRNDArray):
+        raise MXNetError("sparse.dot: lhs must be a CSRNDArray")
+    from ..ops import apply_op
+
+    rhs_nd = rhs if isinstance(rhs, NDArray) else NDArray(rhs)
+    n_out = lhs.shape[1] if transpose_a else lhs.shape[0]
+    return apply_op("dot_csr", lhs.data, lhs.indices, lhs._rows(), rhs_nd,
+                    num_rows=n_out, transpose_a=transpose_a)
 
 
 class RowSparseNDArray(BaseSparseNDArray):

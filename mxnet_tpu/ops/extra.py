@@ -558,3 +558,26 @@ def _tril_indices(n=1, k=0, m=None):
         return tuple(jnp.tril_indices(n, k, m))
 
     return f
+
+
+@register("dot_csr")
+def _dot_csr(num_rows=0, transpose_a=False):
+    """Device CSR × dense product (reference: src/operator/tensor/dot.cc
+    CSR forward, python/mxnet/ndarray/sparse.py dot).
+
+    Inputs: values (nnz,), col_ids (nnz,), row_ids (nnz,), dense (K,) or
+    (K, N). XLA-native sparse formulation: gather the dense rows each
+    stored entry touches, scale by the value, and ``segment_sum`` into the
+    output — static shapes throughout, autodiff supplies the dense-side
+    (and value-side) gradients.
+    """
+
+    def f(values, col_ids, row_ids, dense):
+        out_ids = col_ids if transpose_a else row_ids
+        gather_ids = row_ids if transpose_a else col_ids
+        g = dense[gather_ids]
+        contrib = values[:, None] * g if g.ndim > 1 else values * g
+        return jax.ops.segment_sum(contrib, out_ids,
+                                   num_segments=int(num_rows))
+
+    return f

@@ -101,6 +101,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (BQ, BK) f32
+        ok = None
         if causal:
             # bottom-right alignment (matches _attention_reference and the
             # custom_vjp backward): query i attends keys <= i + (Tk - Tq)
@@ -108,22 +109,25 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_q,
                 jnp.int32, (block_q, block_k), 0)
             ki = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qi + causal_offset >= ki, s, _NEG_INF)
+            ok = qi + causal_offset >= ki
         if use_seg:
             # tokens attend within their segment only (padding tokens get a
             # segment id of their own, so padded keys never contribute)
             qs = qs_ref[:].reshape(block_q, 1)
             ks = ks_ref[0, pl.ds(kb * block_k, block_k)].reshape(1, block_k)
             seg_ok = qs == ks
-            s = jnp.where(seg_ok, s, _NEG_INF)
+            ok = seg_ok if ok is None else (ok & seg_ok)
+        if ok is not None:
+            s = jnp.where(ok, s, _NEG_INF)
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if use_seg:
-            # zero p explicitly: _NEG_INF is finite, so a fully masked row
-            # has s == m_new and p would otherwise be 1 everywhere (output
-            # must be zeros, matching the XLA reference and the bwd kernels)
-            p = jnp.where(seg_ok, p, 0.0)
+        if ok is not None:
+            # zero p under the COMBINED mask: _NEG_INF is finite, so a row
+            # with no visible keys in this block has s == m_new and p would
+            # otherwise be 1 everywhere (fully masked rows must emit zeros,
+            # matching the XLA reference and the bwd kernels)
+            p = jnp.where(ok, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -369,19 +373,23 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # (BQ, BK)
+        ok = None
         if causal:
             qi = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             ki = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qi + causal_offset >= ki, s, _NEG_INF)
+            ok = qi + causal_offset >= ki
+            s = jnp.where(ok, s, _NEG_INF)
         p = jnp.exp(s - lse)                                  # normalized
         if use_seg:
-            # mask p itself: for a fully masked row lse was clamped, so
-            # exp(s - lse) is not reliably ~0 there
             qs = qs_ref[0, pl.ds(qb * block_q, block_q)].reshape(block_q, 1)
             ks = ks_ref[:].reshape(1, block_k)
-            p = jnp.where(qs == ks, p, 0.0)
+            ok = (qs == ks) if ok is None else (ok & (qs == ks))
+        if ok is not None:
+            # mask p under the COMBINED mask: for a fully masked row lse
+            # was clamped, so exp(s - lse) is not reliably ~0 there
+            p = jnp.where(ok, p, 0.0)
         gf = g.astype(jnp.float32)
         dv_acc[:] += jax.lax.dot_general(
             p, gf, (((0,), (0,)), ((), ())),
@@ -423,17 +431,21 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        ok = None
         if causal:
             qi = qb * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             ki = kb * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qi + causal_offset >= ki, s, _NEG_INF)
+            ok = qi + causal_offset >= ki
+            s = jnp.where(ok, s, _NEG_INF)
         p = jnp.exp(s - lse)
         if use_seg:
             qs = qs_ref[:].reshape(block_q, 1)
             ks = ks_ref[0, pl.ds(kb * block_k, block_k)].reshape(1, block_k)
-            p = jnp.where(qs == ks, p, 0.0)
+            ok = (qs == ks) if ok is None else (ok & (qs == ks))
+        if ok is not None:
+            p = jnp.where(ok, p, 0.0)
         dp = jax.lax.dot_general(
             gf, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)

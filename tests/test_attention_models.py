@@ -371,3 +371,44 @@ def test_flash_attention_fully_masked_row_zeros(monkeypatch):
         kv_segment_ids=jnp.asarray(ks)))
     assert float(onp.abs(out[1]).max()) == 0.0
     assert float(onp.abs(out[0]).max()) > 0.0
+
+
+def test_flash_attention_causal_plus_segments(monkeypatch):
+    """causal + segment ids combined: Pallas kernels must match the XLA
+    reference, including rows whose segment-valid keys are ALL causally
+    masked (left padding) — those rows emit zeros, never future-token V."""
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    from mxnet_tpu.ops.pallas_kernels import (_attention_reference,
+                                              flash_attention)
+
+    rng = onp.random.RandomState(23)
+    B, H, T, D = 1, 1, 256, 64
+    q = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    g = jnp.asarray(rng.randn(B, H, T, D).astype("float32"))
+    seg = onp.zeros((B, T), onp.int32)
+    seg[:, 100:] = 1  # LEFT padding: first 100 tokens are padding (id 0)
+    segj = jnp.asarray(seg)
+    out, vjp = jax.vjp(
+        lambda q_, k_, v_: flash_attention(
+            q_, k_, v_, None, True, q_segment_ids=segj,
+            kv_segment_ids=segj), q, k, v)
+    ref, rvjp = jax.vjp(
+        lambda q_, k_, v_: _attention_reference(
+            q_, k_, v_, 1.0 / D ** 0.5, True, segj, segj), q, k, v)
+    assert float(jnp.abs(out - ref).max()) < 1e-4
+    for a, b in zip(vjp(g), rvjp(g)):
+        assert float(jnp.abs(a - b).max()) < 1e-4
+    # the first padded query attends NO causally-visible same-segment key
+    # in its own group? — padding ids match each other causally, so check
+    # instead with q_seg forced distinct: row 0 sees nothing
+    seg_q = onp.full((B, T), 7, onp.int32)
+    seg_q[:, :1] = 5  # query 0: no key shares id 5
+    out2 = flash_attention(q, k, v, None, True,
+                           q_segment_ids=jnp.asarray(seg_q),
+                           kv_segment_ids=jnp.asarray(seg))
+    assert float(jnp.abs(onp.asarray(out2)[0, 0, 0]).max()) == 0.0

@@ -1079,6 +1079,7 @@ COVERED_ELSEWHERE = {
     "multihead_attention": "test_attention_models.py",
     "flash_attention": "test_attention_models.py",
     "box_nms": "test_vision_ops.py",
+    "dot_csr": "test_aux_modules.py (device CSR dot)",
     "box_encode": "test_vision_ops.py",
     # spatial-warping / deformable tier — forward+grad oracles
     "bilinear_sampler": "test_warp_ops.py",
