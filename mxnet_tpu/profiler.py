@@ -14,13 +14,22 @@ import os
 import time
 
 __all__ = ["set_config", "start", "stop", "dump", "dumps", "pause", "resume",
-           "scope", "record", "Profiler"]
+           "scope", "record", "Profiler", "mark_step", "dump_memory_csv",
+           "memory_records"]
 
 _config = {"profile_all": False, "filename": "profile.json",
-           "aggregate_stats": False}
+           "aggregate_stats": False, "profile_memory": False}
 _trace_dir = None
 _running = False
 _ranges = {}  # name -> [total_s, count]
+
+# -- per-allocation tracking (reference: src/profiler/storage_profiler.h) ---
+# every buffer first seen inside a profiler scope is attributed to it:
+# _alloc_stats aggregates per (scope, shape, dtype); _scope_by_id lets the
+# top-K live-buffer table name each buffer's birth scope
+_alloc_stats = {}   # (scope, shape, dtype) -> [count, nbytes_total]
+_scope_by_id = {}   # id(jax.Array) -> scope name (pruned against live set)
+_steps = []         # (step_name, live_bytes, peak_bytes_or_None)
 
 
 def set_config(**kwargs):
@@ -149,25 +158,118 @@ def dumps(reset=False, format="table"):
         lines.append(f"peak_bytes_in_use: {mem['peak_bytes_in_use']:,}")
         if mem.get("bytes_in_use") is not None:
             lines.append(f"bytes_in_use:      {mem['bytes_in_use']:,}")
+    if _config.get("profile_memory") and (_alloc_stats or _steps):
+        lines.append("")
+        lines.append(f"{'Memory scope':<32}{'Shape':<20}{'Count':>6}"
+                     f"{'Bytes':>14}")
+        by_scope: dict[str, int] = {}
+        for s, shp, dt, c, b in memory_records():
+            by_scope[s] = by_scope.get(s, 0) + b
+            lines.append(f"{s[:32]:<32}{'x'.join(map(str, shp))[:19]:<20}"
+                         f"{c:>6}{b:>14,}")
+        for s, b in sorted(by_scope.items(), key=lambda kv: -kv[1]):
+            lines.append(f"{'total ' + s[:26]:<52}{'':>6}{b:>14,}")
+        lines.append("")
+        lines.append(f"{'Top live buffers':<32}{'Shape':<20}"
+                     f"{'Dtype':<10}{'Bytes':>14}")
+        for nbytes, shp, dt, s in _top_live_buffers():
+            lines.append(f"{s[:32]:<32}{'x'.join(map(str, shp))[:19]:<20}"
+                         f"{dt:<10}{nbytes:>14,}")
+        for name, live, peak in _steps:
+            extra = f"  peak_bytes_in_use={peak:,}" if peak is not None \
+                else ""
+            lines.append(f"{name}: live_bytes={live:,}{extra}")
     if reset:
         _ranges.clear()
+        _alloc_stats.clear()
+        _steps.clear()
+        _scope_by_id.clear()
     return "\n".join(lines)
 
 
 @contextlib.contextmanager
 def scope(name="<unk>"):
-    """Named profiling scope; shows up in xplane and the aggregate table."""
+    """Named profiling scope; shows up in xplane and the aggregate table.
+    With ``set_config(profile_memory=True)``, buffers allocated inside the
+    scope are attributed to it (reference: storage_profiler.h profiler
+    scopes on GPU allocations)."""
     import jax
 
+    track = _config.get("profile_memory")
+    if track:
+        before = {id(a) for a in jax.live_arrays()}
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
     dt = time.perf_counter() - t0
     tot, cnt = _ranges.get(name, (0.0, 0))
     _ranges[name] = (tot + dt, cnt + 1)
+    if track:
+        live_now = jax.live_arrays()
+        # prune attributions of freed buffers every scope exit — id() values
+        # recycle, so a stale entry would both mislabel a new buffer and
+        # leak map entries in scope-only usage
+        alive = {id(a) for a in live_now}
+        for bid in [b for b in _scope_by_id if b not in alive]:
+            del _scope_by_id[bid]
+        for a in live_now:
+            if id(a) in before:
+                continue
+            _scope_by_id[id(a)] = name
+            key = (name, tuple(a.shape), str(a.dtype))
+            ent = _alloc_stats.setdefault(key, [0, 0])
+            ent[0] += 1
+            ent[1] += a.nbytes
 
 
 record = scope
+
+
+def mark_step(name=None):
+    """Record one training step's memory watermark: total live buffer
+    bytes, plus the backend's peak_bytes_in_use when it reports one
+    (reference: per-step rows of the GPU memory profiler)."""
+    import jax
+
+    arrs = jax.live_arrays()  # one heap walk for bytes AND pruning
+    live = sum(a.nbytes for a in arrs)
+    peak = device_memory_info().get("peak_bytes_in_use")
+    _steps.append((name or f"step{len(_steps)}", live, peak))
+    alive = {id(a) for a in arrs}
+    for bid in [b for b in _scope_by_id if b not in alive]:
+        del _scope_by_id[bid]
+
+
+def memory_records():
+    """Aggregated per-allocation rows: (scope, shape, dtype, count, bytes)."""
+    return [(s, shp, dt, c, b)
+            for (s, shp, dt), (c, b) in sorted(_alloc_stats.items())]
+
+
+def dump_memory_csv(path):
+    """CSV dump of per-allocation stats (reference: storage_profiler.h:131
+    GpuMemoryProfiler CSV: name, requested size, actual size)."""
+    import csv
+
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["scope", "shape", "dtype", "count", "total_bytes",
+                    "kind"])
+        for row in memory_records():
+            w.writerow([row[0], "x".join(map(str, row[1])), row[2],
+                        row[3], row[4], "alloc"])
+        for name, live, peak in _steps:
+            w.writerow([name, "", "", "", live, "live_bytes"])
+            if peak is not None:
+                w.writerow([name, "", "", "", peak, "peak_bytes_in_use"])
+
+
+def _top_live_buffers(k=10):
+    import jax
+
+    arrs = sorted(jax.live_arrays(), key=lambda a: -a.nbytes)[:k]
+    return [(a.nbytes, tuple(a.shape), str(a.dtype),
+             _scope_by_id.get(id(a), "<untracked>")) for a in arrs]
 
 
 class Profiler:
