@@ -14,17 +14,17 @@ from __future__ import annotations
 import threading
 
 from .loss_scaler import LossScaler, DynamicLossScaler, StaticLossScaler
+from .lists import AMP_DTYPES, FP32_FUNCS, MXU_FUNCS
 
 __all__ = ["init", "is_enabled", "target_dtype", "scale_loss", "unscale",
            "convert_hybrid_block", "LossScaler", "DynamicLossScaler",
-           "StaticLossScaler", "autocast"]
+           "StaticLossScaler", "autocast", "MXU_FUNCS", "FP32_FUNCS",
+           "AMP_DTYPES", "resolve_dtype"]
 
-# ops that benefit from bf16 inputs on the MXU (reference: FP16_FUNCS list)
-MXU_OPS = frozenset({
-    "fully_connected", "convolution", "deconvolution", "matmul", "dot",
-    "batch_dot", "einsum", "multihead_attention", "flash_attention",
-    "tensordot",
-})
+# ops that benefit from low-precision inputs on the MXU — the audited list
+# lives in amp/lists.py (reference: amp/lists/symbol_fp16.py FP16_FUNCS)
+MXU_OPS = frozenset(MXU_FUNCS)
+FP32_OPS = frozenset(FP32_FUNCS)
 
 _state = threading.local()
 
@@ -36,12 +36,29 @@ def _st():
     return _state
 
 
+def resolve_dtype(name):
+    """Normalize + validate an AMP dtype name (single chokepoint used by
+    ``init``, ``autocast``, and the registry's cast wrapper)."""
+    dt = str(name)
+    if dt == "float8_e4m3":  # common alias
+        dt = "float8_e4m3fn"
+    if dt not in AMP_DTYPES:
+        raise ValueError(
+            f"amp target_dtype must be one of {AMP_DTYPES}, got {name!r}")
+    return dt
+
+
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
-    """Enable mixed precision (reference: amp.init, amp/amp.py:308)."""
+    """Enable mixed precision (reference: amp.init, amp/amp.py:308).
+
+    ``target_dtype``: one of ``AMP_DTYPES`` — bf16 (TPU default), fp16
+    (reference parity), or fp8-e4m3/e5m2 for v5p+ MXUs.
+    """
+    dt = resolve_dtype(target_dtype)  # validate BEFORE flipping any state
     st = _st()
     st.enabled = True
-    st.dtype = str(target_dtype)
+    st.dtype = dt
     return True
 
 
@@ -61,7 +78,7 @@ class autocast:
     """Context manager enabling AMP locally."""
 
     def __init__(self, dtype="bfloat16"):
-        self.dtype = dtype
+        self.dtype = resolve_dtype(dtype)
 
     def __enter__(self):
         st = _st()

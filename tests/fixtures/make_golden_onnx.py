@@ -1,0 +1,123 @@
+"""Generate golden .onnx fixtures with an INDEPENDENT wire-format writer.
+
+This script deliberately does NOT import mxnet_tpu's protobuf codec: every
+byte is assembled here from the protobuf wire specification and the field
+numbers in onnx/onnx.proto, so the committed fixtures constitute an
+external check of the in-tree reader/writer (the closest possible analog
+to onnx/onnxruntime validation in a zero-egress image).
+
+Run:  python tests/fixtures/make_golden_onnx.py
+"""
+import os
+import struct
+
+
+def vint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field, wire):
+    return vint((field << 3) | wire)
+
+
+def f_varint(field, v):
+    return tag(field, 0) + vint(v)
+
+
+def f_len(field, payload):
+    return tag(field, 2) + vint(len(payload)) + payload
+
+
+def f_str(field, s):
+    return f_len(field, s.encode())
+
+
+def dim(v):  # TensorShapeProto.Dimension { dim_value = 1 (varint) }
+    return f_varint(1, v)
+
+
+def tensor_type(elem, dims):
+    # TypeProto.Tensor { elem_type=1, shape=2 { dim=1 repeated } }
+    shape = b"".join(f_len(1, dim(d)) for d in dims)
+    t = f_varint(1, elem) + f_len(2, shape)
+    # TypeProto { tensor_type = 1 }
+    return f_len(1, t)
+
+
+def value_info(name, elem, dims):
+    # ValueInfoProto { name=1, type=2 }
+    return f_str(1, name) + f_len(2, tensor_type(elem, dims))
+
+
+def node(op_type, inputs, outputs, name):
+    # NodeProto { input=1 rep, output=2 rep, name=3, op_type=4 }
+    b = b"".join(f_str(1, i) for i in inputs)
+    b += b"".join(f_str(2, o) for o in outputs)
+    b += f_str(3, name) + f_str(4, op_type)
+    return b
+
+
+def init_tensor(name, floats, dims):
+    # TensorProto { dims=1 rep varint, data_type=2, name=8, raw_data=9 }
+    b = b"".join(f_varint(1, d) for d in dims)
+    b += f_varint(2, 1)  # FLOAT
+    b += f_str(8, name)
+    b += f_len(9, struct.pack(f"<{len(floats)}f", *floats))
+    return b
+
+
+def model(graph, producer):
+    # ModelProto { ir_version=1, producer_name=2, graph=7, opset_import=8 }
+    opset = f_str(1, "") + f_varint(2, 13)  # OperatorSetId {domain, version}
+    return (f_varint(1, 8) + f_str(2, producer) + f_len(7, graph) +
+            f_len(8, opset))
+
+
+def graph(nodes, name, inits, inputs, outputs):
+    # GraphProto { node=1 rep, name=2, initializer=5 rep, input=11 rep,
+    #              output=12 rep }
+    b = b"".join(f_len(1, n) for n in nodes)
+    b += f_str(2, name)
+    b += b"".join(f_len(5, i) for i in inits)
+    b += b"".join(f_len(11, i) for i in inputs)
+    b += b"".join(f_len(12, o) for o in outputs)
+    return b
+
+
+def main():
+    here = os.path.dirname(__file__)
+    # golden 1: Y = Add(X, W), W = [1, 2, 3]
+    g = graph(
+        nodes=[node("Add", ["X", "W"], ["Y"], "add0")],
+        name="golden_add",
+        inits=[init_tensor("W", [1.0, 2.0, 3.0], [3])],
+        inputs=[value_info("X", 1, [3])],
+        outputs=[value_info("Y", 1, [3])],
+    )
+    with open(os.path.join(here, "golden_add.onnx"), "wb") as f:
+        f.write(model(g, "golden-spec-writer"))
+
+    # golden 2: Y = Relu(MatMul(X, W)); X (2,2), W (2,2)
+    g2 = graph(
+        nodes=[node("MatMul", ["X", "W"], ["H"], "mm0"),
+               node("Relu", ["H"], ["Y"], "relu0")],
+        name="golden_mlp",
+        inits=[init_tensor("W", [1.0, -1.0, 0.5, 2.0], [2, 2])],
+        inputs=[value_info("X", 1, [2, 2])],
+        outputs=[value_info("Y", 1, [2, 2])],
+    )
+    with open(os.path.join(here, "golden_matmul_relu.onnx"), "wb") as f:
+        f.write(model(g2, "golden-spec-writer"))
+    print("wrote golden fixtures")
+
+
+if __name__ == "__main__":
+    main()
