@@ -1,0 +1,87 @@
+"""Op-surface accounting vs the reference's NNVM registrations.
+
+Prints how every `NNVM_REGISTER_OP(name)` in the reference's src/operator
+maps onto this framework's registry: matched directly, matched via alias /
+snake-case, or residual with the reason it has no standalone counterpart
+(backward nodes are autodiff-derived here; fusion/TensorRT/MKLDNN/TVM
+internals are subsumed by XLA).
+
+Run:  JAX_PLATFORMS=cpu python tools/op_coverage.py [/path/to/reference]
+"""
+import os
+import re
+import subprocess
+import sys
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+RESIDUAL_REASONS = (
+    ("_backward", "backward node — derived by jax autodiff, not a "
+                  "standalone op here"),
+    ("Backward", "backward node — derived by jax autodiff"),
+    ("_grad", "gradient helper — autodiff-derived"),
+    ("_FusedOp", "NVRTC pointwise fusion engine internal — XLA fuses"),
+    ("_TensorRT", "TensorRT subgraph op — gated stub by design"),
+    ("_sg_mkldnn", "oneDNN subgraph op — CPU fast path not needed"),
+    ("_contrib_tvm", "TVMOp bridge — out of scope per SURVEY"),
+    ("_CuDNN", "cuDNN-specific variant — XLA lowers the base op"),
+    ("CuDNN", "cuDNN-specific variant"),
+    ("_Native", "legacy C plugin bridge"),
+    ("_NDArray", "legacy C plugin bridge"),
+    ("_CrossDevice", "multi-GPU copy node — PJRT transfers subsume"),
+    ("_Custom", "custom-op C bridge — mx.operator implements in python"),
+    ("_image_", "image op — covered under image namespace name"),
+    ("_split_v2_backward", "backward node"),
+    ("name", "macro artifact in reference source, not an op"),
+)
+
+
+def residual_reason(name):
+    for prefix, why in RESIDUAL_REASONS:
+        if name.startswith(prefix) or name == prefix:
+            return why
+    if "backward" in name.lower():
+        return "backward node — derived by jax autodiff"
+    return None
+
+
+def main():
+    ref = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+    out = subprocess.run(
+        ["grep", "-rhoE", r"NNVM_REGISTER_OP\(([A-Za-z0-9_]+)\)",
+         os.path.join(ref, "src/operator"), "--include=*.cc"],
+        capture_output=True, text=True).stdout
+    ref_names = sorted({m.group(1) for m in
+                        re.finditer(r"NNVM_REGISTER_OP\((\w+)\)", out)})
+
+    import mxnet_tpu  # noqa: F401 — registers everything
+    from mxnet_tpu.ops.registry import _OPS
+
+    ours = set(_OPS)
+    matched, residual, unmapped = [], [], []
+    for r in ref_names:
+        snake = re.sub(r"(?<!^)(?=[A-Z])", "_", r).lower().lstrip("_")
+        if {r, snake, r.lstrip("_"), r.lower()} & ours:
+            matched.append(r)
+        elif residual_reason(r):
+            residual.append((r, residual_reason(r)))
+        else:
+            unmapped.append(r)
+    print(f"reference NNVM registrations: {len(ref_names)}")
+    print(f"matched by name/alias:        {len(matched)}")
+    print(f"residual (by design):         {len(residual)}")
+    for name, why in residual:
+        print(f"    {name:<40} {why}")
+    print(f"UNMAPPED (gaps):              {len(unmapped)}")
+    for name in unmapped:
+        print(f"    {name}")
+    return len(unmapped)
+
+
+if __name__ == "__main__":
+    sys.exit(1 if main() else 0)
