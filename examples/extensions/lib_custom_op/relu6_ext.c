@@ -1,0 +1,46 @@
+/* Worked native extension (reference: example/extensions/lib_custom_op):
+ * implements relu6 and hardswish as host float32 kernels behind the
+ * versioned mxtpu extensions ABI.
+ *
+ * Build:  gcc -shared -fPIC -O2 -I include -o librelu6_ext.so \
+ *             examples/extensions/lib_custom_op/relu6_ext.c
+ * Load:   mx.library.load("librelu6_ext.so")
+ */
+#include "mxtpu/lib_api.h"
+
+int mxtpu_ext_abi_version(void) { return MXTPU_EXT_ABI_VERSION; }
+
+int mxtpu_ext_init(void) { return 0; }
+
+int mxtpu_ext_num_ops(void) { return 2; }
+
+const char* mxtpu_ext_op_name(int op_idx) {
+  switch (op_idx) {
+    case 0: return "ext_relu6";
+    case 1: return "ext_hardswish";
+    default: return 0;
+  }
+}
+
+int mxtpu_ext_op_compute(int op_idx, const float* in, float* out,
+                         int64_t n) {
+  int64_t i;
+  switch (op_idx) {
+    case 0:
+      for (i = 0; i < n; ++i) {
+        float v = in[i];
+        out[i] = v < 0.f ? 0.f : (v > 6.f ? 6.f : v);
+      }
+      return 0;
+    case 1:
+      for (i = 0; i < n; ++i) {
+        float v = in[i];
+        float r = v + 3.f;
+        r = r < 0.f ? 0.f : (r > 6.f ? 6.f : r);
+        out[i] = v * r / 6.f;
+      }
+      return 0;
+    default:
+      return 1;
+  }
+}

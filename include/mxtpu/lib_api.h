@@ -1,0 +1,49 @@
+/* mxnet_tpu extensions ABI — versioned C contract for out-of-tree native
+ * libraries (reference: include/mxnet/lib_api.h, MX_LIBRARY_VERSION +
+ * MXLoadLib c_api.cc:1522).
+ *
+ * An extension shared object exports, with C linkage:
+ *
+ *   int mxtpu_ext_abi_version(void);
+ *       Must return MXTPU_EXT_ABI_VERSION this header was compiled
+ *       against. The loader refuses mismatched majors (version / 100).
+ *
+ *   int mxtpu_ext_num_ops(void);
+ *   const char* mxtpu_ext_op_name(int op_idx);
+ *       Enumerate the operators this library provides.
+ *
+ *   int mxtpu_ext_op_compute(int op_idx,
+ *                            const float* in, float* out, int64_t n);
+ *       v1 compute contract: elementwise float32, `n` elements in both
+ *       buffers, returns 0 on success / nonzero error code. The python
+ *       loader wraps this as a host-resident op (jit=False) — the TPU
+ *       compute path belongs to Pallas/XLA; native extensions cover
+ *       host-side kernels (custom decoders, samplers, metrics).
+ *
+ *   (optional) int mxtpu_ext_init(void);
+ *       Called once after load; nonzero aborts the load.
+ */
+#ifndef MXTPU_LIB_API_H_
+#define MXTPU_LIB_API_H_
+
+#include <stdint.h>
+
+/* major*100 + minor: minor bumps are backward compatible */
+#define MXTPU_EXT_ABI_VERSION 100
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+int mxtpu_ext_abi_version(void);
+int mxtpu_ext_num_ops(void);
+const char* mxtpu_ext_op_name(int op_idx);
+int mxtpu_ext_op_compute(int op_idx, const float* in, float* out,
+                         int64_t n);
+int mxtpu_ext_init(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_LIB_API_H_ */

@@ -1,13 +1,21 @@
-"""Extension library loading.
+"""Extension library loading — python modules AND versioned native ABI.
 
 Reference: python/mxnet/library.py + the versioned C ABI
-(include/mxnet/lib_api.h, MXLoadLib c_api.cc:1522) for out-of-tree custom
-ops / graph passes / subgraph properties. TPU-native extension model: an
-extension is a PYTHON module (optionally backed by its own native code or
-Pallas kernels) that registers ops via mxnet_tpu.ops.register, custom ops via
-mxnet_tpu.operator.register, optimizers/initializers via their registries, or
-graph passes via mxnet_tpu.subgraph. ``load()`` imports the module from a
-file path and invokes its ``register_ops(registry)`` hook if present.
+(include/mxnet/lib_api.h, MX_LIBRARY_VERSION, MXLoadLib c_api.cc:1522) for
+out-of-tree custom ops / graph passes / subgraph properties. Two extension
+models here:
+
+- PYTHON module (.py): registers ops via mxnet_tpu.ops.register, custom
+  ops via mxnet_tpu.operator.register, optimizers/initializers via their
+  registries, or graph passes via mxnet_tpu.subgraph. ``load()`` imports
+  it and invokes its ``register_ops()`` hook.
+- NATIVE shared object (.so/.dylib): the versioned C contract of
+  ``include/mxtpu/lib_api.h`` (MXTPU_EXT_ABI_VERSION; the loader refuses
+  mismatched majors). v1 exposes enumerated elementwise f32 host kernels,
+  registered as jit=False host ops — the TPU compute path belongs to
+  Pallas/XLA, native extensions cover host-side kernels (decoders,
+  samplers, metrics). Worked example:
+  examples/extensions/lib_custom_op/relu6_ext.c.
 """
 from __future__ import annotations
 
@@ -17,21 +25,34 @@ import sys
 
 from .base import MXNetError
 
-__all__ = ["load", "loaded_libraries"]
+__all__ = ["load", "loaded_libraries", "ABI_VERSION"]
+
+ABI_VERSION = 100  # must match include/mxtpu/lib_api.h
 
 _loaded: dict[str, object] = {}
 
 
 def load(path, verbose=True):
-    """Load an extension module from a .py file (reference: mx.library.load).
+    """Load an extension (reference: mx.library.load).
 
-    The module may define ``register_ops()`` which is called after import.
+    ``.py`` imports a python extension module (optional ``register_ops()``
+    hook); ``.so``/``.dylib`` binds a native library over the versioned
+    extensions ABI and registers every op it enumerates.
     """
     path = os.path.abspath(path)
     if not os.path.exists(path):
         raise MXNetError(f"extension {path} not found")
     if path in _loaded:
         return _loaded[path]
+    if path.endswith((".so", ".dylib")):
+        handle = _load_native(path)
+    else:
+        handle = _load_python(path)
+    _loaded[path] = handle
+    return handle
+
+
+def _load_python(path):
     name = "mxnet_tpu_ext_" + os.path.splitext(os.path.basename(path))[0]
     spec = importlib.util.spec_from_file_location(name, path)
     if spec is None or spec.loader is None:
@@ -41,8 +62,89 @@ def load(path, verbose=True):
     spec.loader.exec_module(module)
     if hasattr(module, "register_ops"):
         module.register_ops()
-    _loaded[path] = module
     return module
+
+
+def _load_native(path):
+    import ctypes
+
+    import numpy as onp
+
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        raise MXNetError(f"cannot dlopen extension {path}: {e}") from e
+    for sym in ("mxtpu_ext_abi_version", "mxtpu_ext_num_ops",
+                "mxtpu_ext_op_name", "mxtpu_ext_op_compute"):
+        if not hasattr(lib, sym):
+            raise MXNetError(
+                f"extension {path} does not export required ABI symbol "
+                f"{sym!r} (see include/mxtpu/lib_api.h)")
+    lib.mxtpu_ext_abi_version.restype = ctypes.c_int
+    lib.mxtpu_ext_num_ops.restype = ctypes.c_int
+    lib.mxtpu_ext_op_name.restype = ctypes.c_char_p
+    lib.mxtpu_ext_op_name.argtypes = [ctypes.c_int]
+    lib.mxtpu_ext_op_compute.restype = ctypes.c_int
+    lib.mxtpu_ext_op_compute.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    got = int(lib.mxtpu_ext_abi_version())
+    if got // 100 != ABI_VERSION // 100 or got % 100 > ABI_VERSION % 100:
+        raise MXNetError(
+            f"extension {path} was built against ABI {got}, this runtime "
+            f"provides {ABI_VERSION} — major versions must match and the "
+            "extension's minor may not exceed the runtime's")
+    if hasattr(lib, "mxtpu_ext_init"):
+        lib.mxtpu_ext_init.restype = ctypes.c_int
+        rc = int(lib.mxtpu_ext_init())
+        if rc:
+            raise MXNetError(f"extension {path} init failed (rc={rc})")
+
+    from .ops.registry import register
+
+    def make_op(idx):
+        def make_fn(**attrs):
+            if attrs:  # v1 native ops take no attrs — reject, don't ignore
+                raise MXNetError(
+                    f"native extension ops accept no attrs, got "
+                    f"{sorted(attrs)}")
+
+            def f(x):
+                arr = onp.ascontiguousarray(onp.asarray(x),
+                                            dtype=onp.float32)
+                out = onp.empty_like(arr)
+                rc = lib.mxtpu_ext_op_compute(
+                    idx,
+                    arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    arr.size)
+                if rc:
+                    raise MXNetError(
+                        f"native extension op failed (rc={rc})")
+                return out
+            return f
+        return make_fn
+
+    # validate the WHOLE enumeration before touching the registry, so a
+    # bad entry (null name, collision with an existing op) cannot leave a
+    # half-registered library behind
+    names = []
+    for i in range(int(lib.mxtpu_ext_num_ops())):
+        raw = lib.mxtpu_ext_op_name(i)
+        if not raw:
+            raise MXNetError(f"extension {path}: op {i} has no name")
+        names.append(raw.decode())
+    from .ops.registry import _OPS
+
+    taken = [n for n in names if n in _OPS]
+    if taken:
+        raise MXNetError(
+            f"extension {path}: op names already registered: {taken}")
+    for i, op_name in enumerate(names):
+        register(op_name, make_op(i), differentiable=False, jit=False)
+    lib._mxtpu_op_names = names  # introspection for tests/tools
+    return lib
 
 
 def loaded_libraries():

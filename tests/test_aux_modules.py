@@ -506,3 +506,56 @@ def test_amp_autocast_validates_and_aliases():
         amp.autocast("bfloat17")
     assert amp.autocast("float8_e4m3").dtype == "float8_e4m3fn"
     assert amp.resolve_dtype("bfloat16") == "bfloat16"
+
+
+def test_native_extension_abi(tmp_path):
+    """Versioned native extensions ABI (reference: include/mxnet/lib_api.h
+    + MXLoadLib): compile the worked C example with the system toolchain,
+    load it, run its ops, and verify major-version rejection."""
+    import shutil
+    import subprocess
+
+    if shutil.which("gcc") is None:
+        pytest.skip("no C toolchain")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    so = tmp_path / "librelu6_ext.so"
+    subprocess.run(
+        ["gcc", "-shared", "-fPIC", "-O2", "-I", os.path.join(root,
+                                                              "include"),
+         "-o", str(so),
+         os.path.join(root, "examples", "extensions", "lib_custom_op",
+                      "relu6_ext.c")],
+        check=True)
+    from mxnet_tpu import library
+    from mxnet_tpu.ops import apply_op
+    from mxnet_tpu.ops.registry import _OPS
+
+    try:
+        lib = library.load(str(so))
+        assert lib._mxtpu_op_names == ["ext_relu6", "ext_hardswish"]
+        x = onp.array([-2.0, 0.5, 7.0, 3.0], "float32")
+        out = apply_op("ext_relu6", np.array(x)).asnumpy()
+        assert_almost_equal(out, onp.clip(x, 0, 6), rtol=1e-6)
+        hs = apply_op("ext_hardswish", np.array(x)).asnumpy()
+        assert_almost_equal(hs, x * onp.clip(x + 3, 0, 6) / 6, rtol=1e-6)
+        with pytest.raises(mx.MXNetError, match="accept no attrs"):
+            apply_op("ext_relu6", np.array(x), alpha=0.1)
+    finally:
+        _OPS.pop("ext_relu6", None)
+        _OPS.pop("ext_hardswish", None)
+        library._loaded.pop(str(so), None)
+
+    # ABI major mismatch must be refused
+    bad_c = tmp_path / "bad.c"
+    bad_c.write_text(
+        '#include <stdint.h>\n'
+        'int mxtpu_ext_abi_version(void) { return 200; }\n'
+        'int mxtpu_ext_num_ops(void) { return 0; }\n'
+        'const char* mxtpu_ext_op_name(int i) { return 0; }\n'
+        'int mxtpu_ext_op_compute(int i, const float* a, float* b,'
+        ' int64_t n) { return 0; }\n')
+    bad_so = tmp_path / "libbad.so"
+    subprocess.run(["gcc", "-shared", "-fPIC", "-o", str(bad_so),
+                    str(bad_c)], check=True)
+    with pytest.raises(mx.MXNetError, match="major versions must match"):
+        library.load(str(bad_so))
