@@ -233,20 +233,81 @@ def flash_attention(q, k, v, scale=None, causal=False, q_segment_ids=None,
 
     Pallas online-softmax kernel on TPU; identical XLA math elsewhere.
     ``q_segment_ids``/``kv_segment_ids`` are optional (B, T) int arrays:
-    tokens attend only within matching segment ids, which covers BERT
-    key-padding masks (valid tokens id 1, padding id 0) and packed
-    sequences — without materializing an O(T²) mask.
+    tokens attend only within matching segment ids, which
+    covers BERT key-padding masks (valid tokens id 1, padding id 0) and
+    packed sequences — without materializing an O(T²) mask.
+
+    Ragged sequence lengths (not block-divisible, e.g. BERT T=384) stay on
+    the fused path: operands are padded to block shape and the padding is
+    hidden behind sentinel segment ids, then the output is sliced back.
     """
-    if q_segment_ids is None and kv_segment_ids is None:
-        return _flash_attention_plain(q, k, v, scale, causal)
     if kv_segment_ids is None:
         kv_segment_ids = q_segment_ids
     if q_segment_ids is None:
         q_segment_ids = kv_segment_ids
+    if _use_pallas():
+        _, _, ok = _blocks_ok(q, k)
+        tq, tk = q.shape[2], k.shape[2]
+        if not ok and (not causal or tq == tk):
+            # under causal, padding both seqs by the SAME amount preserves
+            # the bottom-right alignment offset (tk - tq); with tq != tk
+            # that cannot be guaranteed, so those rare shapes fall back
+            return _flash_attention_padded(q, k, v, scale, causal,
+                                           q_segment_ids, kv_segment_ids)
+    if q_segment_ids is None:
+        return _flash_attention_plain(q, k, v, scale, causal)
     return _flash_attention_seg(q, k, v,
                                 q_segment_ids.astype(jnp.int32),
                                 kv_segment_ids.astype(jnp.int32),
                                 scale, causal)
+
+
+def _block_padded_len(t, big_block):
+    """Smallest length >= t that tiles: <=256 → multiple of 8 (Mosaic
+    sublane), <=big_block → exactly big_block's next boundary, else a
+    multiple of big_block."""
+    if t <= 256:
+        return -(-t // 8) * 8
+    if t <= big_block:
+        return big_block
+    return -(-t // big_block) * big_block
+
+
+def _axis_tiles(t, block):
+    return t % min(block, t) == 0
+
+
+def _flash_attention_padded(q, k, v, scale, causal, q_seg, k_seg):
+    b, _, tq, d = q.shape
+    tk = k.shape[2]
+    if causal:  # tq == tk here: one common padded length keeps the offset
+        lq = lk = max(_block_padded_len(tq, DEFAULT_BLOCK_Q),
+                      _block_padded_len(tk, DEFAULT_BLOCK_K))
+    else:
+        # pad only the axes that don't already tile (e.g. non-causal
+        # T=384: q needs 512 but k tiles at bk=384 — leave k alone)
+        lq = tq if _axis_tiles(tq, DEFAULT_BLOCK_Q) else \
+            _block_padded_len(tq, DEFAULT_BLOCK_Q)
+        lk = tk if _axis_tiles(tk, DEFAULT_BLOCK_K) else \
+            _block_padded_len(tk, DEFAULT_BLOCK_K)
+
+    def padt(x, length):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, length - x.shape[2]),
+                           (0, 0)))
+
+    if q_seg is None:
+        q_seg = jnp.ones((b, tq), jnp.int32)
+        k_seg = jnp.ones((b, tk), jnp.int32)
+    # ids are doubled (even) so the ODD sentinels can never collide with
+    # any user id — including negative ones; equality between real pairs
+    # is preserved. (|id| must fit int32 after doubling.)
+    q_seg = jnp.pad(q_seg.astype(jnp.int32) * 2, ((0, 0), (0, lq - tq)),
+                    constant_values=-1)
+    k_seg = jnp.pad(k_seg.astype(jnp.int32) * 2, ((0, 0), (0, lk - tk)),
+                    constant_values=-3)
+    out = _flash_attention_seg(padt(q, lq), padt(k, lk), padt(v, lk),
+                               q_seg, k_seg, scale, causal)
+    return out[:, :, :tq]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))

@@ -412,3 +412,58 @@ def test_flash_attention_causal_plus_segments(monkeypatch):
                            q_segment_ids=jnp.asarray(seg_q),
                            kv_segment_ids=jnp.asarray(seg))
     assert float(jnp.abs(onp.asarray(out2)[0, 0, 0]).max()) == 0.0
+
+
+def test_flash_attention_ragged_shapes_stay_fused(monkeypatch):
+    """Non-block-divisible lengths (BERT T=384 etc.) pad onto the Pallas
+    path behind sentinel segment ids and match the XLA reference."""
+    import jax
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("MXTPU_PALLAS_INTERPRET", "1")
+    import mxnet_tpu.ops.pallas_kernels as pk
+
+    rng = onp.random.RandomState(29)
+    cases = [
+        (1, 2, 384, 384, 64, True, None),     # BERT-ish, causal
+        (2, 1, 300, 300, 32, False, None),    # even smaller, uneven
+        (1, 1, 300, 700, 16, False, None),    # ragged cross lengths
+        (1, 2, 384, 384, 64, False, "pad"),   # ragged + padding mask
+        (1, 1, 300, 300, 16, False, "neg"),   # NEGATIVE user ids
+    ]
+    for (B, H, Tq, Tk, D, causal, seg_kind) in cases:
+        q = jnp.asarray(rng.randn(B, H, Tq, D).astype("float32"))
+        k = jnp.asarray(rng.randn(B, H, Tk, D).astype("float32"))
+        v = jnp.asarray(rng.randn(B, H, Tk, D).astype("float32"))
+        g = jnp.asarray(rng.randn(B, H, Tq, D).astype("float32"))
+        seg = None
+        if seg_kind == "pad":
+            s = onp.ones((B, Tk), onp.int32)
+            s[:, 350:] = 0
+            seg = jnp.asarray(s)
+        elif seg_kind == "neg":
+            # user ids of -1/-2 must NOT collide with padding sentinels
+            s = onp.full((B, Tk), -1, onp.int32)
+            s[:, 150:] = -2
+            seg = jnp.asarray(s)
+        ref, rvjp = jax.vjp(
+            lambda q_, k_, v_: pk._attention_reference(
+                q_, k_, v_, 1.0 / D ** 0.5, causal, seg, seg), q, k, v)
+        # the fused path must actually run: any fallback to the XLA
+        # reference inside flash_attention is a test failure
+        def _boom(*a, **kw):
+            raise AssertionError("fell back to _attention_reference")
+
+        orig_ref = pk._attention_reference
+        pk._attention_reference = _boom
+        try:
+            out, vjp = jax.vjp(
+                lambda q_, k_, v_: pk.flash_attention(
+                    q_, k_, v_, None, causal, q_segment_ids=seg,
+                    kv_segment_ids=seg), q, k, v)
+            grads = vjp(g)
+        finally:
+            pk._attention_reference = orig_ref
+        assert float(jnp.abs(out - ref).max()) < 1e-4, (Tq, Tk, causal)
+        for a, bb in zip(grads, rvjp(g)):
+            assert float(jnp.abs(a - bb).max()) < 1e-4, (Tq, Tk, causal)
