@@ -138,6 +138,23 @@ def bench_bert_pretrain(size="base"):
     maker = bert_base if size == "base" else bert_large
     bert = maker(max_length=T, dropout=0.1, dtype="float32")
     model = BERTForPretraining(bert, vocab_size=30522)
+
+    if os.environ.get("BENCH_BERT_PADDED", "1") == "1":
+        # realistic padded batches: a fixed 7/8-valid key-padding mask per
+        # row keeps attention on the fused segment-ids flash path (the
+        # HLO carries the masked kernel, not an O(T²) where-mask)
+        class _PaddedBERT(gluon.HybridBlock):
+            def __init__(self, inner, t_valid):
+                super().__init__()
+                self.inner = inner
+                self._t_valid = t_valid
+
+            def forward(self, tokens):
+                vlen = mx.np.full((tokens.shape[0],), self._t_valid,
+                                  dtype="float32")
+                return self.inner(tokens, None, vlen)
+
+        model = _PaddedBERT(model, T * 7 // 8)
     model.initialize()
     amp.convert_hybrid_block(model, "bfloat16")
     amp.init("bfloat16")

@@ -262,15 +262,11 @@ def flash_attention(q, k, v, scale=None, causal=False, q_segment_ids=None,
                                 scale, causal)
 
 
-def _block_padded_len(t, big_block):
-    """Smallest length >= t that tiles: <=256 → multiple of 8 (Mosaic
-    sublane), <=big_block → exactly big_block's next boundary, else a
-    multiple of big_block."""
-    if t <= 256:
-        return -(-t // 8) * 8
-    if t <= big_block:
-        return big_block
-    return -(-t // big_block) * big_block
+def _block_padded_len(t, block):
+    """Next multiple of ``block`` >= t. Only reached for t > 256 (the q
+    axis) / t > 512 (the k axis): any t <= its block size tiles trivially
+    because the block clamps to min(block, t)."""
+    return -(-t // block) * block
 
 
 def _axis_tiles(t, block):
@@ -295,6 +291,15 @@ def _flash_attention_padded(q, k, v, scale, causal, q_seg, k_seg):
         return jnp.pad(x, ((0, 0), (0, 0), (0, length - x.shape[2]),
                            (0, 0)))
 
+    if q_seg is None and (lk == tk or causal):
+        # no masking needed: padded KEYS are either absent (k unpadded) or
+        # causally invisible (common-length padding puts them at indices
+        # >= tk > any real query's reach); padded QUERY rows are sliced
+        # off and their zero output-cotangents keep the backward exact —
+        # so the cheaper plain kernel runs, with no seg operands
+        out = _flash_attention_plain(padt(q, lq), padt(k, lk),
+                                     padt(v, lk), scale, causal)
+        return out[:, :, :tq]
     if q_seg is None:
         q_seg = jnp.ones((b, tq), jnp.int32)
         k_seg = jnp.ones((b, tk), jnp.int32)
