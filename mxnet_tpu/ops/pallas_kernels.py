@@ -263,9 +263,11 @@ def flash_attention(q, k, v, scale=None, causal=False, q_segment_ids=None,
 
 
 def _block_padded_len(t, block):
-    """Next multiple of ``block`` >= t. Only reached for t > 256 (the q
-    axis) / t > 512 (the k axis): any t <= its block size tiles trivially
-    because the block clamps to min(block, t)."""
+    """Next multiple of ``block`` >= t. Reached only when some axis fails
+    to tile, which requires t > 256 on the q axis; the causal branch also
+    evaluates the k rule with t <= 512 (result: one block). Any t <= its
+    own block size tiles trivially because the block clamps to
+    min(block, t)."""
     return -(-t // block) * block
 
 
