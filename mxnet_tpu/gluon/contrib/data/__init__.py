@@ -1,0 +1,5 @@
+"""gluon.contrib.data — experimental data pipelines (reference:
+python/mxnet/gluon/contrib/data)."""
+from . import vision
+
+__all__ = ["vision"]

@@ -228,3 +228,62 @@ def test_zero_grad():
     assert float(abs(net.weight.grad()).sum()) > 0
     net.zero_grad()
     assert float(abs(net.weight.grad()).sum()) == 0
+
+
+def test_contrib_data_vision_bbox_transforms():
+    """gluon.contrib.data.vision (reference: contrib/data/vision): bbox
+    Block transforms keep images and boxes consistent, and the detection
+    loader pads ragged box counts with -1."""
+    import random as pyrandom
+
+    from mxnet_tpu.gluon.contrib.data import vision as cdv
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    pyrandom.seed(11)
+    img = onp.arange(20 * 30 * 3, dtype="uint8").reshape(20, 30, 3)
+    bbox = onp.array([[2, 3, 10, 12, 7], [15, 5, 28, 18, 2]], "float32")
+
+    # flip with p=1: x coords mirror, extra column intact
+    fi, fb = cdv.ImageBboxRandomFlipLeftRight(p=1.0)(NDArray(img),
+                                                     NDArray(bbox))
+    assert (fi.asnumpy() == img[:, ::-1]).all()
+    got = fb.asnumpy()
+    assert_almost_equal(got[0, :4], [30 - 10, 3, 30 - 2, 12], rtol=1e-6)
+    assert got[0, 4] == 7 and got[1, 4] == 2
+
+    # crop: second box's center is outside -> dropped; first translated
+    ci, cb = cdv.ImageBboxCrop((0, 0, 14, 14))(NDArray(img), NDArray(bbox))
+    assert ci.shape == (14, 14, 3)
+    assert cb.shape[0] == 1
+    assert_almost_equal(cb.asnumpy()[0, :4], [2, 3, 10, 12], rtol=1e-6)
+
+    # expand: boxes translate by the offset; canvas filled
+    ei, eb = cdv.ImageBboxRandomExpand(p=1.0, max_ratio=2, fill=9)(
+        NDArray(img), NDArray(bbox))
+    eia = ei.asnumpy()
+    assert eia.shape[0] >= 20 and eia.shape[1] >= 30
+    w_off = eb.asnumpy()[0, 0] - 2
+    h_off = eb.asnumpy()[0, 1] - 3
+    assert w_off >= 0 and h_off >= 0
+    assert (eia[int(h_off):int(h_off) + 20,
+                int(w_off):int(w_off) + 30] == img).all()
+
+    # resize: coordinates scale with the image
+    ri, rb = cdv.ImageBboxResize(60, 40)(NDArray(img), NDArray(bbox))
+    assert ri.shape[:2] == (40, 60)
+    assert_almost_equal(rb.asnumpy()[0, :4], [4, 6, 20, 24], rtol=1e-5)
+
+    # constrained random crop keeps at least one valid box
+    ki, kb = cdv.ImageBboxRandomCropWithConstraints(p=1.0)(
+        NDArray(img), NDArray(bbox))
+    assert kb.shape[0] >= 1 and ki.asnumpy().ndim == 3
+
+    # detection loader pads ragged box counts with -1
+    samples = [(onp.zeros((8, 8, 3), "float32"),
+                onp.ones((n, 5), "float32")) for n in (1, 3, 2, 3)]
+    ds = gluon.data.SimpleDataset(samples)
+    loader = cdv.ImageBboxDataLoader(ds, batch_size=2)
+    batches = list(loader)
+    assert batches[0][1].shape == (2, 3, 5)
+    lbl = batches[0][1].asnumpy()
+    assert (lbl[0, 1:] == -1).all() and (lbl[1] == 1).all()
