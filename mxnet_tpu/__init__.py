@@ -26,6 +26,8 @@ from .context import (Context, Device, cpu, cpu_pinned, gpu, tpu, device,
                       current_context, current_device, num_gpus, num_tpus,
                       tpu_memory_info, gpu_memory_info)
 from . import engine
+from . import dlpack
+from . import error
 from . import ops
 from .ndarray.ndarray import NDArray, array, from_jax
 from . import autograd

@@ -1,0 +1,90 @@
+"""DLPack zero-copy interop (reference: python/mxnet/dlpack.py over
+3rdparty/dlpack).
+
+jax arrays speak DLPack natively, so the TPU-native implementation rides
+``jax.dlpack`` / the ``__dlpack__`` protocol: NDArrays exchange buffers
+with torch / numpy / cupy without a host round-trip on shared-memory
+backends. The reference's read/write capsule split exists because its
+engine must order reads vs writes; PJRT buffers are immutable, so both
+spellings hand out the same capsule and ``from_dlpack`` produces a fresh
+NDArray handle.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack",
+           "DLDeviceType"]
+
+
+class DLDeviceType:
+    """DLPack device type codes (dlpack.h)."""
+
+    DLCPU = 1
+    DLGPU = 2
+    DLCPUPINNED = 3
+
+
+def to_dlpack_for_read(data):
+    """NDArray → DLPack capsule (reference: ndarray_to_dlpack_for_read).
+    The capsule may alias the live buffer — consumers must treat it as
+    read-only (that is this spelling's contract)."""
+    if not isinstance(data, NDArray):
+        raise MXNetError(f"expected NDArray, got {type(data).__name__}")
+    data.wait_to_read()
+    return data.__dlpack__()
+
+
+def to_dlpack_for_write(data):
+    """NDArray → DLPack capsule the consumer may write into (reference:
+    ndarray_to_dlpack_for_write). PJRT buffers are immutable and may be
+    aliased by jit caches, so the exported buffer is a fresh COPY — the
+    consumer's in-place writes are theirs alone and are not reflected
+    back into the NDArray (writes here rebind, never mutate)."""
+    import jax.numpy as jnp
+
+    if not isinstance(data, NDArray):
+        raise MXNetError(f"expected NDArray, got {type(data).__name__}")
+    copy = jnp.array(data._data, copy=True)
+    copy.block_until_ready()
+    return copy.__dlpack__()
+
+
+class _CapsuleExchange:
+    """Adapter: modern jax consumes the ``__dlpack__`` protocol, while the
+    reference API (and torch's exporter) hand around bare capsules. A bare
+    capsule carries no queryable device tag, so this adapter declares host
+    memory — and ``from_dlpack`` only takes this path when the framework
+    backend IS the host, where a device-memory capsule cannot exist."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (DLDeviceType.DLCPU, 0)
+
+
+def from_dlpack(obj):
+    """DLPack capsule or any ``__dlpack__``-bearing object → NDArray."""
+    import jax
+    import jax.numpy as jnp
+
+    if not hasattr(obj, "__dlpack__"):
+        if jax.default_backend() != "cpu":
+            # a bare capsule cannot tell us which device its pointer lives
+            # on; guessing wrong imports device memory as host (garbage or
+            # segfault). Protocol objects carry __dlpack_device__ — require
+            # them off-host.
+            raise MXNetError(
+                "from_dlpack on an accelerator backend needs an object "
+                "implementing __dlpack__/__dlpack_device__ (pass the "
+                "source array itself, not a bare capsule)")
+        obj = _CapsuleExchange(obj)
+    try:
+        return NDArray(jnp.from_dlpack(obj))
+    except Exception as e:  # noqa: BLE001 — normalize to framework error
+        raise MXNetError(f"from_dlpack failed: {e}") from e

@@ -55,7 +55,9 @@ def wait_for_var(data) -> None:
     except MXNetError:
         raise
     except Exception as e:  # noqa: BLE001 — normalize XLA/PJRT errors
-        raise MXNetError(str(e)) from e
+        from .error import _normalize
+
+        raise _normalize(str(e)) from e
 
 
 def wait_all() -> None:

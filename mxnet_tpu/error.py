@@ -1,0 +1,56 @@
+"""Structured error classes (reference: python/mxnet/error.py).
+
+The reference registers error types so C++ messages like
+``ValueError: ...`` re-raise as the right python class; here errors are
+born in python, so ``register`` simply records the mapping used by
+``_normalize`` (applied where backend/XLA messages are wrapped).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "register"]
+
+_ERROR_TYPES: dict[str, type] = {}
+
+
+class InternalError(MXNetError):
+    """An error that should never happen — file a bug if it does."""
+
+
+def register(func_name=None, cls=None):
+    """Register an error class under a message prefix (reference:
+    error.register). Usable as ``@register`` or ``@register("Prefix")``."""
+
+    def do_register(mycls):
+        name = func_name if isinstance(func_name, str) else mycls.__name__
+        _ERROR_TYPES[name] = mycls
+        return mycls
+
+    if isinstance(func_name, type):  # bare @register
+        return do_register(func_name)
+    if cls is not None:
+        return do_register(cls)
+    return do_register
+
+
+register(InternalError)
+
+# dual-inheritance error classes (reference pattern): a backend
+# "ValueError: ..." surfaces as a class that isinstance-checks as BOTH
+# MXNetError (the framework contract at sync points) and the builtin
+_BUILTIN = (ValueError, TypeError, IndexError, KeyError, AttributeError,
+            NotImplementedError)
+for _py in _BUILTIN:
+    _ERROR_TYPES[_py.__name__] = type(_py.__name__, (MXNetError, _py), {})
+
+
+def _normalize(message: str) -> BaseException:
+    """Map a ``Type: message`` string to the registered exception class;
+    the result is always an MXNetError (possibly also a builtin type)."""
+    if ": " in message:
+        kind, rest = message.split(": ", 1)
+        cls = _ERROR_TYPES.get(kind)
+        if cls is not None and issubclass(cls, MXNetError):
+            return cls(message)
+    return MXNetError(message)

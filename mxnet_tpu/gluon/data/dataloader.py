@@ -178,6 +178,11 @@ def _worker_loop(dataset_pkl, batchify_pkl, task_q, result_q):
 
 
 class DataLoader:
+    """Batched loader over a Dataset; see module docstring for the worker
+    models. ``pin_memory`` is accepted for reference API parity and is a
+    no-op: PJRT stages host→HBM transfers itself, and the shared-memory
+    worker transport already lands batches in page-aligned host buffers."""
+
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,

@@ -253,3 +253,46 @@ def test_ufunc_at_and_npi_identity_shape():
     eye3 = apply_op("_npi_identity", shape=(3, 3))
     assert eye3.shape == (3, 3)
     assert_almost_equal(eye3, onp.identity(3, "float32"))
+
+
+def test_dlpack_interop_torch_and_numpy():
+    """mx.dlpack (reference: python/mxnet/dlpack.py): capsules round-trip
+    through numpy and torch (cpu) without corrupting values."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import dlpack
+
+    x = mx.np.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    cap = dlpack.to_dlpack_for_read(x)
+    back = dlpack.from_dlpack(cap)
+    assert (back.asnumpy() == x.asnumpy()).all()
+
+    # numpy -> mx via the __dlpack__ protocol
+    src = onp.arange(6, dtype="float32") + 1
+    nd = dlpack.from_dlpack(src)
+    assert (nd.asnumpy() == src).all()
+
+    try:
+        import torch
+    except ImportError:
+        return
+    t = torch.utils.dlpack.from_dlpack(
+        dlpack.to_dlpack_for_write(mx.np.array([1.0, 2.0, 3.0])))
+    assert t.tolist() == [1.0, 2.0, 3.0]
+    nd2 = dlpack.from_dlpack(torch.arange(4, dtype=torch.float32))
+    assert nd2.asnumpy().tolist() == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_error_module_registry():
+    import mxnet_tpu as mx
+    from mxnet_tpu import error
+
+    assert issubclass(error.InternalError, mx.MXNetError)
+    e = error._normalize("ValueError: bad thing")
+    assert isinstance(e, ValueError) and "bad thing" in str(e)
+    assert isinstance(error._normalize("no prefix"), mx.MXNetError)
+
+    @error.register("CustomKind")
+    class CustomKind(mx.MXNetError):
+        pass
+
+    assert isinstance(error._normalize("CustomKind: x"), CustomKind)
