@@ -28,6 +28,8 @@ from .context import (Context, Device, cpu, cpu_pinned, gpu, tpu, device,
 from . import engine
 from . import dlpack
 from . import error
+from . import libinfo
+from . import log
 from . import ops
 from .ndarray.ndarray import NDArray, array, from_jax
 from . import autograd

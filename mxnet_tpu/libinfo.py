@@ -1,0 +1,34 @@
+"""Library/runtime info (reference: python/mxnet/libinfo.py —
+find_lib_path, find_include_path, __version__).
+
+There is no libmxnet.so here; the "library" is jax/XLA plus this
+package's optional native pieces (src/io_native, the extensions ABI), so
+the finders report those.
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+__version__ = "0.1.0"
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def find_lib_path(prefix=None):
+    """Paths of this package's built native libraries (the io_native
+    engine and any compiled extension objects next to the package)."""
+    pats = [os.path.join(_ROOT, "src", "io_native", "*.so"),
+            os.path.join(_ROOT, "build", "*.so")]
+    out = []
+    for p in pats:
+        out.extend(sorted(glob.glob(p)))
+    return out
+
+
+def find_include_path():
+    """C headers consumers compile against (the extensions ABI)."""
+    inc = os.path.join(_ROOT, "include")
+    return inc if os.path.isdir(inc) else ""

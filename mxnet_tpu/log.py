@@ -1,0 +1,47 @@
+"""Logging helpers (reference: python/mxnet/log.py — getLogger with a
+colored level formatter). Thin by design: python logging does the work."""
+from __future__ import annotations
+
+import logging
+import sys
+
+__all__ = ["getLogger", "get_logger"]
+
+_COLORS = {"WARNING": "\033[0;33m", "ERROR": "\033[0;31m",
+           "CRITICAL": "\033[0;31m", "DEBUG": "\033[0;32m"}
+_RESET = "\033[0m"
+
+
+class _LevelFormatter(logging.Formatter):
+    def __init__(self, colored):
+        super().__init__("%(asctime)s %(message)s", "%H:%M:%S")
+        self._colored = colored
+
+    def format(self, record):
+        label = f"{record.levelname[0]} "
+        if self._colored and record.levelname in _COLORS:
+            label = f"{_COLORS[record.levelname]}{label}{_RESET}"
+        return label + super().format(record)
+
+
+def getLogger(name=None, filename=None, filemode=None,
+              level=logging.WARNING):
+    """Create/fetch a logger configured like the reference's (colored
+    level prefix on ttys, plain elsewhere/in files)."""
+    logger = logging.getLogger(name)
+    if getattr(logger, "_mxtpu_configured", False):
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or "a")
+        colored = False
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+        colored = hasattr(sys.stderr, "isatty") and sys.stderr.isatty()
+    handler.setFormatter(_LevelFormatter(colored))
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_configured = True
+    return logger
+
+
+get_logger = getLogger

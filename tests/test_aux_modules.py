@@ -559,3 +559,16 @@ def test_native_extension_abi(tmp_path):
                     str(bad_c)], check=True)
     with pytest.raises(mx.MXNetError, match="major versions must match"):
         library.load(str(bad_so))
+
+
+def test_log_and_libinfo_modules():
+    from mxnet_tpu import libinfo, log
+
+    lg = log.getLogger("mxtpu_test_logger")
+    assert log.getLogger("mxtpu_test_logger") is lg  # configured once
+    assert libinfo.__version__
+    assert isinstance(libinfo.find_lib_path(), list)
+    inc = libinfo.find_include_path()
+    assert inc.endswith("include")
+    import os
+    assert os.path.exists(os.path.join(inc, "mxtpu", "lib_api.h"))
