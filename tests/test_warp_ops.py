@@ -451,3 +451,46 @@ def test_deformable_rfcn_head_trains():
             p._set_data(p._data - 0.5 * p.grad._data)
             p.grad[:] = 0
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("stride,dilate,pad,ng,dg,bias", [
+    ((2, 2), (1, 1), (1, 1), 1, 1, True),
+    ((1, 2), (2, 1), (2, 0), 1, 1, False),
+    ((1, 1), (2, 2), (2, 2), 2, 2, True),
+])
+def test_deformable_conv_attr_matrix(stride, dilate, pad, ng, dg, bias):
+    """Forward parity across stride/dilate/pad/group combinations."""
+    C, F = 4, 4
+    data = _r(2, C, 9, 10, seed=31)
+    weight = _r(F, C // ng, 3, 3, seed=32, scale=0.3)
+    b = _r(F, seed=33) if bias else None
+    kh, kw = 3, 3
+    Ho = (9 + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (10 + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+    offset = _r(2, 2 * dg * 9, Ho, Wo, seed=34, scale=0.6)
+    args = [NDArray(data), NDArray(offset), NDArray(weight)]
+    if bias:
+        args.append(NDArray(b))
+    got = _np(apply_op("deformable_convolution", *args, kernel=(3, 3),
+                       stride=stride, dilate=dilate, pad=pad, num_filter=F,
+                       num_group=ng, num_deformable_group=dg,
+                       no_bias=not bias))
+    want = _deform_conv_ref(data, offset, weight, b, (3, 3), stride,
+                            dilate, pad, ng, dg)
+    assert got.shape == want.shape == (2, F, Ho, Wo)
+    assert_almost_equal(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_modulated_deformable_conv_groups_bias():
+    data = _r(1, 4, 7, 7, seed=35)
+    weight = _r(4, 2, 3, 3, seed=36, scale=0.3)  # ng=2
+    bias = _r(4, seed=37)
+    offset = _r(1, 2 * 2 * 9, 5, 5, seed=38, scale=0.4)  # dg=2
+    mask = onp.abs(_r(1, 2 * 9, 5, 5, seed=39))
+    got = _np(apply_op("modulated_deformable_convolution", NDArray(data),
+                       NDArray(offset), NDArray(mask), NDArray(weight),
+                       NDArray(bias), kernel=(3, 3), num_filter=4,
+                       num_group=2, num_deformable_group=2, no_bias=False))
+    want = _deform_conv_ref(data, offset, weight, bias, (3, 3), (1, 1),
+                            (1, 1), (0, 0), 2, 2, mask=mask)
+    assert_almost_equal(got, want, rtol=1e-3, atol=1e-4)
