@@ -26,8 +26,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 512
+import os as _os
+
+# flash-attention tile sizes; env-overridable so on-chip sweeps can tune
+# per shape class without code changes (powers of two; Q also a multiple
+# of 8, K of 128, to stay Mosaic-tileable)
+DEFAULT_BLOCK_Q = int(_os.environ.get("MXTPU_FLASH_BLOCK_Q", 256))
+DEFAULT_BLOCK_K = int(_os.environ.get("MXTPU_FLASH_BLOCK_K", 512))
 _NEG_INF = -1e30
 
 
