@@ -235,12 +235,17 @@ def flash_attention(query, key, value, causal=False, scale=None):
 
 
 def multihead_attention(query, key, value, mask=None, num_heads=1,
-                        dropout=0.0, causal=False, scale=None):
+                        dropout=0.0, causal=False, scale=None,
+                        num_kv_heads=None):
+    """``num_kv_heads`` enables grouped-query / multi-query attention:
+    key/value carry that many heads, each shared by a group of query
+    heads (TPU-native extension beyond the reference)."""
     args = [_nd(query), _nd(key), _nd(value)]
     if mask is not None:
         args.append(_nd(mask))
     return _op("multihead_attention", *args, num_heads=num_heads,
-               dropout=dropout, causal=causal, scale=scale)
+               dropout=dropout, causal=causal, scale=scale,
+               num_kv_heads=num_kv_heads)
 
 
 def adaptive_avg_pool2d(data, output_size=1):

@@ -533,15 +533,35 @@ def _ctc_loss(use_data_lengths=False, use_label_lengths=False, blank_label="firs
 # path routes through the Pallas flash-attention kernel (online softmax,
 # no O(T^2) materialization); arbitrary masks use the XLA path.
 @register("multihead_attention")
-def _multihead_attention(num_heads=1, dropout=0.0, causal=False, scale=None):
+def _multihead_attention(num_heads=1, dropout=0.0, causal=False, scale=None,
+                         num_kv_heads=None):
+    """``num_kv_heads`` (beyond the reference): grouped-query / multi-query
+    attention — k/v carry ``num_kv_heads`` heads, each shared by
+    ``num_heads // num_kv_heads`` query heads (the modern LLM KV-cache
+    shrink). Default None = classic MHA."""
+    n_kv = num_heads if num_kv_heads is None else int(num_kv_heads)
+    if n_kv < 1 or num_heads % n_kv:
+        raise MXNetError(
+            f"num_kv_heads must be a positive divisor of num_heads "
+            f"{num_heads}, got {num_kv_heads}")
+
     def f(q, k, v, *mask):
-        # q,k,v: (B, T, H*D)
+        # q: (B, T, num_heads*D); k/v: (B, T, n_kv*D)
         B, Tq, E = q.shape
         Tk = k.shape[1]
         D = E // num_heads
         qh = q.reshape(B, Tq, num_heads, D).transpose(0, 2, 1, 3)
-        kh = k.reshape(B, Tk, num_heads, D).transpose(0, 2, 1, 3)
-        vh = v.reshape(B, Tk, num_heads, D).transpose(0, 2, 1, 3)
+        kh = k.reshape(B, Tk, n_kv, D).transpose(0, 2, 1, 3)
+        vh = v.reshape(B, Tk, n_kv, D).transpose(0, 2, 1, 3)
+        if n_kv != num_heads:
+            # materializing stopgap: the repeat restores (B, H, T, D) for
+            # the shared kernels; the GQA input/KV-cache stays n_kv-sized,
+            # but attention-time KV traffic matches MHA until the Pallas
+            # kernel grows a native grouped-heads mode (XLA typically folds
+            # the broadcast into the batched matmul on the dense path)
+            reps = num_heads // n_kv
+            kh = jnp.repeat(kh, reps, axis=1)
+            vh = jnp.repeat(vh, reps, axis=1)
         s = scale if scale is not None else 1.0 / (D ** 0.5)
         if not mask:
             from .pallas_kernels import flash_attention

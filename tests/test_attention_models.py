@@ -467,3 +467,40 @@ def test_flash_attention_ragged_shapes_stay_fused(monkeypatch):
         assert float(jnp.abs(out - ref).max()) < 1e-4, (Tq, Tk, causal)
         for a, bb in zip(grads, rvjp(g)):
             assert float(jnp.abs(a - bb).max()) < 1e-4, (Tq, Tk, causal)
+
+
+def test_multihead_attention_gqa():
+    """num_kv_heads (GQA/MQA): each kv head serves a group of query heads;
+    equivalent to MHA with the kv heads explicitly repeated."""
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu.ops import apply_op
+
+    rng = onp.random.RandomState(31)
+    B, T, H, HKV, D = 2, 8, 4, 2, 8
+    q = rng.randn(B, T, H * D).astype("float32")
+    k = rng.randn(B, T, HKV * D).astype("float32")
+    v = rng.randn(B, T, HKV * D).astype("float32")
+    got = apply_op("multihead_attention", NDArray(q), NDArray(k),
+                   NDArray(v), num_heads=H, num_kv_heads=HKV).asnumpy()
+    # oracle: repeat kv heads to full H and run classic MHA
+    reps = H // HKV
+    kf = k.reshape(B, T, HKV, D).repeat(reps, axis=2).reshape(B, T, H * D)
+    vf = v.reshape(B, T, HKV, D).repeat(reps, axis=2).reshape(B, T, H * D)
+    want = apply_op("multihead_attention", NDArray(q), NDArray(kf),
+                    NDArray(vf), num_heads=H).asnumpy()
+    assert_almost_equal(got, want, rtol=1e-5, atol=1e-6)
+    with pytest.raises(Exception):
+        apply_op("multihead_attention", NDArray(q), NDArray(k), NDArray(v),
+                 num_heads=4, num_kv_heads=3)
+
+
+def test_multihead_attention_gqa_via_npx():
+    rng = onp.random.RandomState(33)
+    B, T, H, HKV, D = 1, 6, 4, 1, 4  # MQA: one shared kv head
+    q = np.array(rng.randn(B, T, H * D).astype("float32"))
+    k = np.array(rng.randn(B, T, HKV * D).astype("float32"))
+    v = np.array(rng.randn(B, T, HKV * D).astype("float32"))
+    out = npx.multihead_attention(q, k, v, num_heads=H, num_kv_heads=HKV)
+    assert out.shape == (B, T, H * D)
+    with pytest.raises(Exception):
+        npx.multihead_attention(q, k, v, num_heads=H, num_kv_heads=0)
