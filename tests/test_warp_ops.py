@@ -494,3 +494,28 @@ def test_modulated_deformable_conv_groups_bias():
     want = _deform_conv_ref(data, offset, weight, bias, (3, 3), (1, 1),
                             (1, 1), (0, 0), 2, 2, mask=mask)
     assert_almost_equal(got, want, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.integration
+def test_stn_example_learns_localization():
+    """The STN example's learned warp must beat the fixed identity warp
+    (shortened run of examples/stn_mnist.py)."""
+    import importlib.util
+    import os
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "stn_mnist_example",
+        os.path.join(os.path.dirname(__file__), "..", "examples",
+                     "stn_mnist.py"))
+    mod = importlib.util.module_from_spec(spec)
+    argv = sys.argv
+    sys.argv = ["stn_mnist.py"]
+    try:
+        spec.loader.exec_module(mod)
+        xs, ys = mod.make_translated_digits(256)
+        acc_stn = mod.train(True, xs, ys, epochs=15)
+        acc_fixed = mod.train(False, xs, ys, epochs=15)
+    finally:
+        sys.argv = argv
+    assert acc_stn > acc_fixed + 0.1, (acc_stn, acc_fixed)
