@@ -1,9 +1,11 @@
 """gluon.rnn (reference: python/mxnet/gluon/rnn/)."""
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, DropoutCell, ZoneoutCell,
-                       ResidualCell, BidirectionalCell)
+                       SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell, VariationalDropoutCell, LSTMPCell)
 from .rnn_layer import RNN, LSTM, GRU
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
-           "BidirectionalCell", "RNN", "LSTM", "GRU"]
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+           "VariationalDropoutCell", "LSTMPCell", "RNN", "LSTM", "GRU"]

@@ -12,8 +12,9 @@ from ... import np as _np
 from ... import numpy_extension as npx
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "DropoutCell", "ZoneoutCell",
-           "ResidualCell", "BidirectionalCell"]
+           "SequentialRNNCell", "HybridSequentialRNNCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell",
+           "VariationalDropoutCell", "LSTMPCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -33,11 +34,16 @@ class RecurrentCell(HybridBlock):
         return states
 
     def reset(self):
-        pass
+        """Clear per-sequence state (e.g. variational dropout masks);
+        containers propagate to children."""
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         """Python unroll over time (reference: rnn_cell.py unroll)."""
+        self.reset()  # fresh per-sequence state, even for nested cells
         axis = layout.find("T")
         batch = inputs.shape[layout.find("N")]
         if begin_state is None:
@@ -108,6 +114,20 @@ class RNNCell(_BaseCell):
         return out, [out]
 
 
+def _lstm_step(x, h, c, n, i2h_w, i2h_b, h2h_w, h2h_b):
+    """One i,f,g,o-gated LSTM update shared by LSTMCell and LSTMPCell."""
+    gates = npx.fully_connected(x, i2h_w.data(), i2h_b.data(),
+                                num_hidden=4 * n, flatten=False) + \
+        npx.fully_connected(h, h2h_w.data(), h2h_b.data(),
+                            num_hidden=4 * n, flatten=False)
+    i = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=0, end=n))
+    f = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=n, end=2 * n))
+    g = _np.tanh(npx.slice_axis(gates, axis=-1, begin=2 * n, end=3 * n))
+    o = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=3 * n, end=4 * n))
+    c_new = f * c + i * g
+    return o * _np.tanh(c_new), c_new
+
+
 class LSTMCell(_BaseCell):
     """LSTM cell, gate order i,f,g,o (reference: rnn_cell.py LSTMCell)."""
 
@@ -121,20 +141,9 @@ class LSTMCell(_BaseCell):
     def forward(self, x, states):
         self._infer(x)
         h, c = states
-        n = self._hidden_size
-        gates = npx.fully_connected(x, self.i2h_weight.data(),
-                                    self.i2h_bias.data(), num_hidden=4 * n,
-                                    flatten=False) + \
-            npx.fully_connected(h, self.h2h_weight.data(),
-                                self.h2h_bias.data(), num_hidden=4 * n,
-                                flatten=False)
-        i = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=0, end=n))
-        f = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=n, end=2 * n))
-        g = _np.tanh(npx.slice_axis(gates, axis=-1, begin=2 * n, end=3 * n))
-        o = npx.sigmoid(npx.slice_axis(gates, axis=-1, begin=3 * n,
-                                       end=4 * n))
-        c_new = f * c + i * g
-        h_new = o * _np.tanh(c_new)
+        h_new, c_new = _lstm_step(x, h, c, self._hidden_size,
+                                  self.i2h_weight, self.i2h_bias,
+                                  self.h2h_weight, self.h2h_bias)
         return h_new, [h_new, c_new]
 
 
@@ -194,6 +203,11 @@ class SequentialRNNCell(RecurrentCell):
         return len(self._children)
 
 
+# every cell here is hybrid-capable; the reference kept a separate class
+# for the pre-Gluon2 Block/HybridBlock split
+HybridSequentialRNNCell = SequentialRNNCell
+
+
 class _ModifierCell(RecurrentCell):
     def __init__(self, base_cell, **kwargs):
         super().__init__(**kwargs)
@@ -201,6 +215,84 @@ class _ModifierCell(RecurrentCell):
 
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
+
+
+class VariationalDropoutCell(_ModifierCell):
+    """Variational (per-sequence) dropout (reference: rnn_cell.py
+    VariationalDropoutCell:1090 — Gal & Ghahramani): ONE dropout mask per
+    sequence for inputs/states/outputs, reused at every time step, unlike
+    DropoutCell's fresh mask per step. ``reset()`` clears the masks; every
+    ``unroll`` (including a containing cell's) calls it."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self.reset()
+
+    def reset(self):
+        self._mask_i = self._mask_s = self._mask_o = None
+        super().reset()
+
+    @staticmethod
+    def _mask(rate, like):
+        # inverted-dropout mask with the keep-scale folded in, sampled once
+        return npx.dropout(_np.ones_like(like), p=rate)
+
+    def forward(self, x, states):
+        from ... import autograd
+
+        if autograd.is_training():
+            if self._di > 0:
+                if self._mask_i is None:
+                    self._mask_i = self._mask(self._di, x)
+                x = x * self._mask_i
+            if self._ds > 0:
+                if self._mask_s is None:
+                    self._mask_s = self._mask(self._ds, states[0])
+                states = [states[0] * self._mask_s] + list(states[1:])
+        out, new_states = self.base_cell(x, states)
+        if autograd.is_training() and self._do > 0:
+            if self._mask_o is None:
+                self._mask_o = self._mask(self._do, out)
+            out = out * self._mask_o
+        return out, new_states
+
+class LSTMPCell(_BaseCell):
+    """LSTM with a hidden-state projection (reference: rnn_cell.py
+    LSTMPCell:1260 — LSTMP, Sak et al. 2014): the cell state has
+    ``hidden_size`` units but the recurrent/output state is projected to
+    ``projection_size`` (gate order i, f, g, o)."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 h2r_weight_initializer=None, h2h_weight_initializer=None,
+                 dtype="float32", **kwargs):
+        super().__init__(hidden_size, 4, input_size, dtype=dtype,
+                         h2h_weight_initializer=h2h_weight_initializer,
+                         **kwargs)
+        self._projection_size = projection_size
+        # the recurrent operand is the PROJECTED state: narrow h2h
+        self.h2h_weight = Parameter(
+            shape=(4 * hidden_size, projection_size),
+            init=h2h_weight_initializer, dtype=dtype)
+        self.h2r_weight = Parameter(shape=(projection_size, hidden_size),
+                                    init=h2r_weight_initializer, dtype=dtype)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        self._infer(x)
+        r, c = states  # r: projected recurrent state, c: cell state
+        h, c_new = _lstm_step(x, r, c, self._hidden_size, self.i2h_weight,
+                              self.i2h_bias, self.h2h_weight, self.h2h_bias)
+        r_new = npx.fully_connected(h, self.h2r_weight.data(), None,
+                                    num_hidden=self._projection_size,
+                                    flatten=False, no_bias=True)
+        return r_new, [r_new, c_new]
 
 
 class DropoutCell(RecurrentCell):

@@ -129,3 +129,87 @@ def test_bidirectional_cell_unroll():
     x = mx.np.random.uniform(size=(2, 5, 4))
     out, states = bi.unroll(5, x, layout="NTC")
     assert out.shape == (2, 5, 6)
+
+
+def test_lstmp_cell_projection():
+    """LSTMPCell (reference rnn_cell.py:1260): recurrent state is the
+    projection; cell state keeps hidden_size; unroll + grads work."""
+    from mxnet_tpu.gluon import rnn
+
+    cell = rnn.LSTMPCell(hidden_size=12, projection_size=5, input_size=6)
+    cell.initialize()
+    x = np.array(onp.random.RandomState(0).randn(3, 7, 6).astype("float32"))
+    out, states = cell.unroll(7, x, layout="NTC")
+    assert out.shape == (3, 7, 5)
+    assert states[0].shape == (3, 5) and states[1].shape == (3, 12)
+    from mxnet_tpu import gluon
+
+    trainer = gluon.Trainer(cell.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    before = cell.h2r_weight.data().asnumpy().copy()
+    with mx.autograd.record():
+        out, _ = cell.unroll(7, x, layout="NTC")
+        loss = (out * out).sum()
+    loss.backward()
+    trainer.step(3)
+    after = cell.h2r_weight.data().asnumpy()
+    assert not (before == after).all()  # projection weight received grads
+
+
+def test_variational_dropout_cell_mask_reuse():
+    """VariationalDropoutCell: ONE mask per sequence (identical across
+    steps), fresh masks per unroll, identity at inference."""
+    from mxnet_tpu.gluon import rnn
+
+    base = rnn.RNNCell(8, input_size=8)
+    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = np.array(onp.ones((2, 6, 8), "float32"))
+    # inference: no dropout
+    out, _ = cell.unroll(6, x, layout="NTC")
+    states = base.begin_state(2)
+    with mx.autograd.record():
+        # step twice inside one sequence: the input mask must be IDENTICAL
+        cell.reset()
+        x0 = np.array(onp.ones((2, 8), "float32"))
+        cell(x0, states)
+        m1 = cell._mask_i.asnumpy()
+        cell(x0, states)
+        m2 = cell._mask_i.asnumpy()
+        assert (m1 == m2).all()
+        cell.reset()
+        cell(x0, states)
+        m3 = cell._mask_i.asnumpy()
+    assert not (m1 == m3).all()  # new sequence, new mask
+    assert set(onp.unique(onp.round(m1, 4))) <= {0.0, 2.0}
+
+
+def test_hybrid_sequential_rnn_cell_alias():
+    from mxnet_tpu.gluon import rnn
+
+    stack = rnn.HybridSequentialRNNCell()
+    stack.add(rnn.LSTMCell(8, input_size=4))
+    stack.add(rnn.GRUCell(6, input_size=8))
+    stack.initialize()
+    x = np.array(onp.random.randn(2, 5, 4).astype("float32"))
+    out, states = stack.unroll(5, x, layout="NTC")
+    assert out.shape == (2, 5, 6)
+
+
+def test_variational_dropout_nested_in_container_resamples():
+    """A VariationalDropoutCell nested in SequentialRNNCell gets fresh
+    masks per unroll (reset propagates through containers)."""
+    from mxnet_tpu.gluon import rnn
+
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.VariationalDropoutCell(rnn.RNNCell(8, input_size=8),
+                                         drop_inputs=0.5))
+    stack.initialize()
+    inner = list(stack._children.values())[0]
+    x = np.array(onp.ones((2, 4, 8), "float32"))
+    with mx.autograd.record():
+        stack.unroll(4, x, layout="NTC")
+        m1 = inner._mask_i.asnumpy()
+        stack.unroll(4, x, layout="NTC")
+        m2 = inner._mask_i.asnumpy()
+    assert not (m1 == m2).all(), "mask not resampled across unrolls"
