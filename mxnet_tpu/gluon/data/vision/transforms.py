@@ -16,10 +16,11 @@ def _host(x):
     happens in the DataLoader, not per sample."""
     return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
 
-__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
-           "RandomResizedCrop", "RandomCrop", "RandomFlipLeftRight",
-           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "RandomLighting"]
+__all__ = ["Compose", "HybridCompose", "Cast", "ToTensor", "Normalize",
+           "Resize", "CenterCrop", "RandomResizedCrop", "RandomCrop",
+           "RandomFlipLeftRight", "RandomFlipTopBottom", "RandomBrightness",
+           "RandomContrast", "RandomSaturation", "RandomLighting",
+           "RandomApply", "HybridRandomApply"]
 
 
 class Compose(Sequential):
@@ -207,3 +208,27 @@ class RandomLighting(_Transform):
         alpha = _random.host_rng.normal(0, self._alpha, size=(3,))
         rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
         return (onp.clip(a + rgb, 0, 255).astype(x.dtype))
+
+
+class RandomApply(Sequential):
+    """Apply the wrapped transforms with probability ``p`` (reference:
+    transforms/__init__.py RandomApply:138)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        if not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self.add(*transforms)
+        self.p = p
+
+    def __call__(self, x, *args):
+        if float(_random.host_rng.uniform()) < self.p:
+            for block in self._children.values():
+                x = block(x)
+        return (x,) + args if args else x
+
+
+# every transform here is hybrid-capable; the reference split exists for
+# the pre-Gluon2 Block/HybridBlock distinction
+HybridCompose = Compose
+HybridRandomApply = RandomApply

@@ -287,3 +287,23 @@ def test_contrib_data_vision_bbox_transforms():
     assert batches[0][1].shape == (2, 3, 5)
     lbl = batches[0][1].asnumpy()
     assert (lbl[0, 1:] == -1).all() and (lbl[1] == 1).all()
+
+
+def test_transforms_random_apply():
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    from mxnet_tpu import random as mxrand
+
+    flip = T.RandomFlipLeftRight()
+    img = onp.zeros((4, 4, 3), "uint8")
+    img[:, 0] = 255  # left column marked
+    always = T.RandomApply([flip], p=1.0)
+    never = T.RandomApply([flip], p=0.0)
+    out_never = never(img)
+    assert (onp.asarray(out_never) == img).all()
+    # p=1: the wrapped flip itself is random; apply several times and
+    # require at least one flip to have occurred
+    flipped = any((onp.asarray(always(img)) != img).any()
+                  for _ in range(16))
+    assert flipped
+    assert T.HybridCompose is T.Compose
+    assert T.HybridRandomApply is T.RandomApply
