@@ -10,7 +10,8 @@ from . import rnn
 from . import model_zoo
 from . import contrib
 from . import probability
+from . import utils
 
 __all__ = ["Parameter", "Constant", "DeferredInitializationError", "Block",
-           "HybridBlock", "SymbolBlock", "Trainer", "nn", "loss", "data",
+           "HybridBlock", "SymbolBlock", "Trainer", "utils", "nn", "loss", "data",
            "metric", "rnn", "model_zoo", "contrib"]

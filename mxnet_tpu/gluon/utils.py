@@ -1,0 +1,8 @@
+"""gluon.utils (reference: python/mxnet/gluon/utils.py) — re-export of the
+framework utils under the reference's module path; the implementations
+live in mxnet_tpu/utils/ and serve both spellings."""
+from ..utils import (split_data, split_and_load, clip_global_norm,
+                     check_sha1, download)
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
