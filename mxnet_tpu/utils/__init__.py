@@ -13,7 +13,7 @@ from ..util import (is_np_array, is_np_shape, set_np, np_array, np_shape,
                     use_np, getenv, setenv)
 
 __all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
-           "is_np_array", "is_np_shape", "set_np", "use_np"]
+           "download", "is_np_array", "is_np_shape", "set_np", "use_np"]
 
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):
@@ -81,3 +81,35 @@ def check_sha1(filename, sha1_hash):
                 break
             sha1.update(data)
     return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download ``url`` to ``path`` (reference: gluon/utils.py download).
+
+    This image is zero-egress, so the function resolves local files and
+    file:// URLs (the model-zoo/test fixture path) and raises a clear
+    error for network URLs instead of hanging on a dead socket.
+    """
+    import os
+    import shutil
+
+    from ..base import MXNetError
+
+    src = url[7:] if url.startswith("file://") else url
+    if os.path.exists(src):
+        fname = path if path and not os.path.isdir(path) else os.path.join(
+            path or ".", os.path.basename(src))
+        if os.path.abspath(src) != os.path.abspath(fname):
+            if os.path.exists(fname) and not overwrite:
+                return fname
+            os.makedirs(os.path.dirname(os.path.abspath(fname)),
+                        exist_ok=True)
+            shutil.copyfile(src, fname)
+        if sha1_hash and not check_sha1(fname, sha1_hash):
+            raise MXNetError(f"sha1 mismatch for {fname}")
+        return fname
+    raise MXNetError(
+        f"download({url!r}): network egress is unavailable in this "
+        "environment; place the file locally and pass its path or a "
+        "file:// URL")

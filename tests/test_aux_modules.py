@@ -572,3 +572,18 @@ def test_log_and_libinfo_modules():
     assert inc.endswith("include")
     import os
     assert os.path.exists(os.path.join(inc, "mxtpu", "lib_api.h"))
+
+
+def test_gluon_utils_module_and_download(tmp_path):
+    """gluon.utils (reference module path): shared impls + zero-egress
+    download resolving local/file:// sources."""
+    from mxnet_tpu.gluon import utils as gutils
+
+    parts = gutils.split_data(np.array(onp.ones((6, 2), "float32")), 3)
+    assert len(parts) == 3
+    src = tmp_path / "w.bin"
+    src.write_bytes(b"abc")
+    got = gutils.download(f"file://{src}", path=str(tmp_path / "o" / "w2"))
+    assert open(got, "rb").read() == b"abc"
+    with pytest.raises(mx.MXNetError, match="egress"):
+        gutils.download("https://nowhere.invalid/x")
