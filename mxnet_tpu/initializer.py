@@ -15,9 +15,9 @@ from .base import MXNetError, Registry
 from .ndarray.ndarray import NDArray
 from . import random as _random
 
-__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
-           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "register", "create"]
+__all__ = ["Initializer", "InitDesc", "Zero", "One", "Constant", "Uniform",
+           "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+           "LSTMBias", "Load", "Mixed", "register", "create"]
 
 _registry = Registry("initializer")
 register = _registry.register
@@ -42,7 +42,17 @@ class Initializer:
     def __call__(self, name, arr=None):
         if arr is None:  # called as init(array) in some legacy code
             arr, name = name, ""
-        self.init_array(name or "", arr)
+        if isinstance(name, InitDesc):
+            # per-variable override wins (reference Initializer.__call__:
+            # the symbol's __init__ attr, then the desc's global_init)
+            spec = name.attrs.get("__init__")
+            if spec:
+                create(spec).init_array(str(name), arr)
+                return
+            if name.global_init is not None and name.global_init is not self:
+                name.global_init.init_array(str(name), arr)
+                return
+        self.init_array(str(name or ""), arr)
 
     def init_array(self, name: str, arr: NDArray):
         name = name.lower()
@@ -197,3 +207,91 @@ class LSTMBias(Initializer):
         n = arr.shape[0] // 4
         b[n:2 * n] = self.forget_bias  # gate order: i, f, g, o
         arr._set_data(jnp.asarray(b).astype(arr.dtype))
+
+
+class InitDesc(str):
+    """String subclass carrying attrs + a fallback initializer (reference:
+    initializer.py:36) — lets name-pattern-driven initializers read the
+    variable's ``__init__`` attr recorded on the symbol."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+@register
+class Load(Initializer):
+    """Initialize from a saved parameter file or name→NDArray dict
+    (reference: initializer.py:316); ``arg:``/``aux:`` prefixes dropped.
+    Names absent from the dict fall back to ``default_init``."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        if isinstance(param, str):
+            from .nd import load as _load
+
+            param = _load(param)
+        if not isinstance(param, dict):
+            raise MXNetError("Load needs a file path or a name->NDArray "
+                             f"dict, got {type(param).__name__}")
+        self.param = {}
+        for name, arr in param.items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[key] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def init_array(self, name, arr):
+        import logging
+
+        if name in self.param:
+            src = self.param[name]
+            if tuple(arr.shape) != tuple(src.shape):
+                raise MXNetError(
+                    f"parameter {name!r} cannot be initialized by loading: "
+                    f"target shape {tuple(arr.shape)} vs loaded "
+                    f"{tuple(src.shape)}")
+            arr._set_data(jnp.asarray(
+                src.asnumpy() if isinstance(src, NDArray) else src,
+                dtype=arr.dtype))
+            if self.verbose:
+                logging.getLogger("mxnet_tpu").info(
+                    "Initialized %s by loading", name)
+        elif self.default_init is not None:
+            self.default_init(name, arr)
+            if self.verbose:
+                logging.getLogger("mxnet_tpu").info(
+                    "Initialized %s by default", name)
+        else:
+            raise MXNetError(
+                f"cannot initialize {name!r}: not in the loaded params and "
+                "no default initializer provided")
+
+
+@register
+class Mixed(Initializer):
+    """Name-pattern-dispatched initialization (reference:
+    initializer.py:363): the first regex in ``patterns`` matching the
+    variable name picks the corresponding initializer; ``.*`` as the last
+    pattern provides the fallback."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        import re
+
+        if len(patterns) != len(initializers):
+            raise MXNetError("Mixed needs equally many patterns and "
+                             "initializers")
+        self.map = [(re.compile(p), init)
+                    for p, init in zip(patterns, initializers)]
+
+    def init_array(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(
+            f"parameter {name!r} did not match any Mixed pattern — add a "
+            "'.*' fallback pattern")

@@ -150,18 +150,26 @@ def elemwise_mul(a, b):
 # (same role; portable numpy interchange like src/serialization/cnpy.cc).
 # ---------------------------------------------------------------------------
 def save(fname, data):
-    if isinstance(data, NDArray):
-        _onp.savez(fname, __single__=data.asnumpy())
-    elif isinstance(data, list):
-        _onp.savez(fname, **{f"__list__{i}": d.asnumpy()
+    # write through a file object: numpy's savez appends '.npz' to bare
+    # paths, which would break the reference contract that
+    # save(fname) + load(fname) round-trips for ANY name (.params etc.)
+    with open(fname, "wb") as f:
+        if isinstance(data, NDArray):
+            _onp.savez(f, __single__=data.asnumpy())
+        elif isinstance(data, list):
+            _onp.savez(f, **{f"__list__{i}": d.asnumpy()
                              for i, d in enumerate(data)})
-    elif isinstance(data, dict):
-        _onp.savez(fname, **{k: v.asnumpy() for k, v in data.items()})
-    else:
-        raise MXNetError(f"cannot save {type(data)}")
+        elif isinstance(data, dict):
+            _onp.savez(f, **{k: v.asnumpy() for k, v in data.items()})
+        else:
+            raise MXNetError(f"cannot save {type(data)}")
 
 
 def load(fname):
+    import os as _os
+
+    if not _os.path.exists(fname) and _os.path.exists(fname + ".npz"):
+        fname = fname + ".npz"  # files written by the pre-fix save()
     with _onp.load(fname) as z:
         keys = list(z.keys())
         if keys == ["__single__"]:

@@ -98,14 +98,19 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
 
     src = url[7:] if url.startswith("file://") else url
     if os.path.exists(src):
-        fname = path if path and not os.path.isdir(path) else os.path.join(
+        # a path that IS a directory, or names one with a trailing slash,
+        # receives the source basename inside it
+        as_dir = path is not None and (os.path.isdir(path) or
+                                       str(path).endswith(os.sep))
+        fname = path if path and not as_dir else os.path.join(
             path or ".", os.path.basename(src))
         if os.path.abspath(src) != os.path.abspath(fname):
-            if os.path.exists(fname) and not overwrite:
-                return fname
-            os.makedirs(os.path.dirname(os.path.abspath(fname)),
-                        exist_ok=True)
-            shutil.copyfile(src, fname)
+            cached_ok = (os.path.exists(fname) and not overwrite and
+                         (not sha1_hash or check_sha1(fname, sha1_hash)))
+            if not cached_ok:
+                os.makedirs(os.path.dirname(os.path.abspath(fname)),
+                            exist_ok=True)
+                shutil.copyfile(src, fname)
         if sha1_hash and not check_sha1(fname, sha1_hash):
             raise MXNetError(f"sha1 mismatch for {fname}")
         return fname

@@ -587,3 +587,62 @@ def test_gluon_utils_module_and_download(tmp_path):
     assert open(got, "rb").read() == b"abc"
     with pytest.raises(mx.MXNetError, match="egress"):
         gutils.download("https://nowhere.invalid/x")
+
+
+def test_initializer_load_and_mixed(tmp_path):
+    """Load + Mixed initializers (reference initializer.py:316,363)."""
+    from mxnet_tpu import initializer as init, nd
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    path = str(tmp_path / "w.params")
+    nd.save(path, {"arg:w": np.array([[1.0, 2.0]]), "b": np.array([5.0])})
+    ld = init.Load(path, default_init=init.Zero())
+    w = NDArray(onp.zeros((1, 2), "float32"))
+    ld("w", w)
+    assert w.asnumpy().tolist() == [[1.0, 2.0]]
+    other = NDArray(onp.ones((3,), "float32"))
+    ld("unknown", other)
+    assert other.asnumpy().tolist() == [0.0, 0.0, 0.0]
+    bad = NDArray(onp.zeros((2, 2), "float32"))
+    with pytest.raises(mx.MXNetError, match="Shape|shape"):
+        ld("w", bad)
+
+    mixed = init.Mixed([".*gamma_custom", ".*"],
+                       [init.One(), init.Constant(3.0)])
+    g = NDArray(onp.zeros((2,), "float32"))
+    mixed("net_gamma_custom", g)
+    assert g.asnumpy().tolist() == [1.0, 1.0]
+    v = NDArray(onp.zeros((2,), "float32"))
+    mixed("anything_else", v)
+    assert v.asnumpy().tolist() == [3.0, 3.0]
+    assert isinstance(init.InitDesc("w", {"a": "1"}), str)
+
+
+def test_initdesc_overrides_and_download_dir(tmp_path):
+    from mxnet_tpu import initializer as init
+    from mxnet_tpu.gluon import utils as gutils
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    # per-variable __init__ attr beats the calling initializer
+    arr = NDArray(onp.full((2,), 7.0, "float32"))
+    init.Uniform()(init.InitDesc("w", {"__init__": "zeros"}), arr)
+    assert arr.asnumpy().tolist() == [0.0, 0.0]
+    # global_init fallback
+    arr2 = NDArray(onp.full((2,), 7.0, "float32"))
+    init.Uniform()(init.InitDesc("w", global_init=init.One()), arr2)
+    assert arr2.asnumpy().tolist() == [1.0, 1.0]
+
+    # download: trailing-slash path = directory; stale cache re-copied
+    # when the hash check fails
+    import hashlib
+
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"good-data")
+    sha = hashlib.sha1(b"good-data").hexdigest()
+    out_dir = str(tmp_path / "newdir") + os.sep
+    got = gutils.download(f"file://{src}", path=out_dir)
+    assert got.endswith("payload.bin") and open(got, "rb").read() == \
+        b"good-data"
+    open(got, "wb").write(b"corrupt")
+    got2 = gutils.download(f"file://{src}", path=out_dir, sha1_hash=sha)
+    assert open(got2, "rb").read() == b"good-data"
