@@ -11,6 +11,14 @@ import time
 
 import numpy as onp
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # a site hook may re-pin the platform config; honor the env override
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 import mxnet_tpu as mx
 from mxnet_tpu import amp, autograd, gluon, np, parallel
 from mxnet_tpu.gluon.model_zoo.bert import BERTForPretraining, bert_base

@@ -6,6 +6,14 @@ from __future__ import annotations
 import argparse
 import time
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # a site hook may re-pin the platform config; honor the env override
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, metric, np
 from mxnet_tpu.gluon import nn

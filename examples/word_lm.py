@@ -9,6 +9,14 @@ import time
 
 import numpy as onp
 
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    # a site hook may re-pin the platform config; honor the env override
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, np
 from mxnet_tpu.gluon.model_zoo.rnn_lm import RNNModel
