@@ -34,7 +34,7 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "DetRandomCropAug",
            "DetRandomPadAug",
            "DetRandomSelectAug",
-           "CreateDetAugmenter"]
+           "CreateDetAugmenter", "scale_down", "copyMakeBorder", "random_size_crop", "imrotate", "random_rotate", "SequentialAug"]
 
 
 def _np(img):
@@ -304,21 +304,9 @@ class RandomSizedCropAug(Augmenter):
         self.ratio = ratio
 
     def __call__(self, src):
-        img = _np(src)
-        h, w = img.shape[:2]
-        src_area = h * w
-        for _ in range(10):
-            target = _random.host_rng.uniform(*self.area) * src_area
-            ar = _random.host_rng.uniform(*self.ratio)
-            nw = int(round((target * ar) ** 0.5))
-            nh = int(round((target / ar) ** 0.5))
-            if nw <= w and nh <= h:
-                x0 = _random.host_rng.randint(0, w - nw + 1)
-                y0 = _random.host_rng.randint(0, h - nh + 1)
-                crop = img[y0:y0 + nh, x0:x0 + nw]
-                return imresize(NDArray(crop.copy()), self.size[0],
-                                self.size[1])
-        return center_crop(src, self.size)[0]
+        # one sampling implementation for both spellings (reference:
+        # RandomSizedCropAug calls random_size_crop)
+        return random_size_crop(src, self.size, self.area, self.ratio)[0]
 
 
 # -- detection augmenters (reference: image/detection.py det_aug family) ----
@@ -637,3 +625,137 @@ class ImageIter(_io.DataIter):
         self.cur += n
         return _io.DataBatch([NDArray(imgs)], [NDArray(labels)],
                              pad=self.batch_size - n)
+
+
+def scale_down(src_size, size):
+    """Shrink a crop size to fit inside the image, keeping aspect
+    (reference: image.py scale_down:214)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0):
+    """Pad an (H, W, C) image border (reference: image.py
+    copyMakeBorder:249 over cv2). ``border_type`` 0 = constant fill,
+    1 = replicate edge."""
+    img = _np(src)
+    pad_width = ((top, bot), (left, right)) + ((0, 0),) * (img.ndim - 2)
+    if border_type == 1:
+        out = onp.pad(img, pad_width, "edge")
+    else:
+        out = onp.pad(img, pad_width, "constant", constant_values=value)
+    return NDArray(out)
+
+
+def random_size_crop(src, size, area, ratio, interp=1, **kwargs):
+    """Random crop with randomized area and aspect ratio, resized to
+    ``size`` (reference: image.py random_size_crop:563). Returns
+    (image, (x0, y0, w, h))."""
+    if "min_area" in kwargs:  # legacy spelling (reference keeps it too)
+        area = kwargs.pop("min_area")
+    if kwargs:
+        raise MXNetError(
+            f"random_size_crop: unexpected arguments {sorted(kwargs)}")
+    img = _np(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _random.host_rng.uniform(*area) * src_area
+        log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
+        ar = onp.exp(_random.host_rng.uniform(*log_ratio))
+        cw = int(round(onp.sqrt(target_area * ar)))
+        ch = int(round(onp.sqrt(target_area / ar)))
+        if cw <= w and ch <= h:
+            x0 = _random.host_rng.randint(0, w - cw + 1)
+            y0 = _random.host_rng.randint(0, h - ch + 1)
+            out = fixed_crop(NDArray(img), x0, y0, cw, ch, size, interp)
+            return out, (x0, y0, cw, ch)
+    # fallback: center crop at the (scaled-down) requested size
+    cw, ch = scale_down((w, h), size)
+    x0, y0 = (w - cw) // 2, (h - ch) // 2
+    return fixed_crop(NDArray(img), x0, y0, cw, ch, size, interp), \
+        (x0, y0, cw, ch)
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate CHW (or NCHW batch) float32 images by degrees (reference:
+    image.py imrotate:618) via inverse affine + bilinear sampling; area
+    outside the source fills with zeros. ``zoom_in`` scales so no padding
+    shows; ``zoom_out`` so the full rotated frame fits."""
+    if zoom_in and zoom_out:
+        raise MXNetError("only one of zoom_in and zoom_out may be set")
+    img = _np(src).astype("float32")
+    batched = img.ndim == 4
+    imgs = img if batched else img[None]
+    n, c, h, w = imgs.shape
+    degs = onp.broadcast_to(onp.asarray(_np(rotation_degrees),
+                                        "float32").reshape(-1), (n,)) \
+        if not onp.isscalar(rotation_degrees) else \
+        onp.full((n,), float(rotation_degrees), "float32")
+    out = onp.zeros_like(imgs)
+    yy, xx = onp.mgrid[0:h, 0:w].astype("float32")
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    for i in range(n):
+        rad = onp.deg2rad(float(degs[i]))
+        cos, sin = onp.cos(rad), onp.sin(rad)
+        # rotated-frame extents of the ACTUAL h x w rectangle: correct
+        # for non-square images (a 90-deg zoom_in of a wide image must
+        # zoom until the short side covers the long axis)
+        ext = max((w * abs(cos) + h * abs(sin)) / w,
+                  (w * abs(sin) + h * abs(cos)) / h)
+        if zoom_in:
+            s = 1.0 / ext
+        elif zoom_out:
+            s = ext
+        else:
+            s = 1.0
+        # inverse map: output pixel -> source coords
+        dx, dy = (xx - cx) * s, (yy - cy) * s
+        sx = cos * dx + sin * dy + cx
+        sy = -sin * dx + cos * dy + cy
+        x0 = onp.floor(sx).astype(int)
+        y0 = onp.floor(sy).astype(int)
+        fx, fy = sx - x0, sy - y0
+        for dyy in (0, 1):
+            for dxx in (0, 1):
+                wgt = (fy if dyy else 1 - fy) * (fx if dxx else 1 - fx)
+                ys_, xs_ = y0 + dyy, x0 + dxx
+                ok = (ys_ >= 0) & (ys_ < h) & (xs_ >= 0) & (xs_ < w)
+                ysc = onp.clip(ys_, 0, h - 1)
+                xsc = onp.clip(xs_, 0, w - 1)
+                out[i] += imgs[i][:, ysc, xsc] * (wgt * ok)[None]
+    res = out if batched else out[0]
+    return NDArray(res)
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    """Rotate by an angle drawn uniformly from ``angle_limits``
+    (reference: image.py random_rotate)."""
+    lo, hi = angle_limits
+    img = _np(src)
+    if img.ndim == 4:
+        angles = _random.host_rng.uniform(lo, hi, size=(img.shape[0],))
+        return imrotate(src, angles, zoom_in, zoom_out)
+    return imrotate(src, float(_random.host_rng.uniform(lo, hi)),
+                    zoom_in, zoom_out)
+
+
+class SequentialAug(Augmenter):
+    """Apply a list of augmenters in order (reference: image.py
+    SequentialAug:787)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src

@@ -194,3 +194,72 @@ def test_cv_ops(tmp_path):
     buf = onp.frombuffer(p.read_bytes(), dtype="uint8")
     dec = apply_op("cvimdecode", _nd(buf))
     assert (dec.asnumpy() == img).all()
+
+
+def test_image_rotate_and_border_helpers():
+    """scale_down / copyMakeBorder / imrotate / random_size_crop /
+    SequentialAug (reference: image.py:214,249,563,618,787)."""
+    from mxnet_tpu import image
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    assert image.scale_down((640, 480), (720, 120)) == (640, 106)
+
+    img = onp.arange(2 * 3 * 3, dtype="uint8").reshape(3, 3, 2)
+    padded = image.copyMakeBorder(NDArray(img), 1, 1, 2, 2, value=7)
+    assert padded.shape == (5, 7, 2)
+    assert (padded.asnumpy()[0] == 7).all()
+    edge = image.copyMakeBorder(NDArray(img), 1, 0, 0, 0, border_type=1)
+    assert (edge.asnumpy()[0] == img[0]).all()
+
+    # 0-degree rotation is identity; 90-degree rotates the pattern
+    chw = onp.zeros((1, 5, 5), "float32")
+    chw[0, 0, :] = 1.0  # top row lit
+    same = image.imrotate(NDArray(chw), 0).asnumpy()
+    assert_almost_equal(same, chw, rtol=1e-5, atol=1e-6)
+    rot = image.imrotate(NDArray(chw), 90).asnumpy()
+    # after 90° the lit ROW becomes a lit COLUMN (direction convention
+    # aside): some column carries the mass, no row does
+    assert rot[0].sum(axis=0).max() > 3.0  # a column is lit
+    assert rot[0].sum(axis=1).max() < 2.0  # no row is lit
+    batch = image.imrotate(NDArray(onp.stack([chw, chw])),
+                           onp.array([0.0, 90.0]))
+    assert_almost_equal(batch.asnumpy()[0], chw, rtol=1e-5, atol=1e-6)
+
+    out, rect = image.random_size_crop(
+        NDArray(onp.ones((10, 12, 3), "uint8")), (4, 4), (0.3, 0.9),
+        (0.7, 1.4))
+    assert out.shape == (4, 4, 3) and len(rect) == 4
+
+    rr = image.random_rotate(NDArray(chw), (-10, 10))
+    assert rr.shape == chw.shape
+
+    seq = image.SequentialAug([image.CastAug("float32"),
+                               image.ResizeAug(6)])
+    out2 = seq(NDArray(onp.ones((8, 9, 3), "uint8")))
+    assert out2.asnumpy().dtype == onp.float32
+
+
+def test_imrotate_zoom_nonsquare_and_gray_border():
+    from mxnet_tpu import image
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    # zoom_in on a WIDE image at 90°: no zero padding may show
+    img = onp.full((1, 20, 40), 5.0, "float32")
+    out = image.imrotate(NDArray(img), 90, zoom_in=True).asnumpy()
+    # interior must be padding-free (the 1-px ring has the usual bilinear
+    # half-pixel edge falloff)
+    assert out[:, 1:-1, 1:-1].min() > 4.99, \
+        f"padding leaked: min={out[:, 1:-1, 1:-1].min()}"
+    # zoom_out keeps every source pixel visible (mass preserved-ish)
+    out2 = image.imrotate(NDArray(img), 45, zoom_out=True).asnumpy()
+    assert out2.max() <= 5.0 + 1e-4
+
+    # grayscale (2-D) border pad
+    g = onp.ones((4, 5), "uint8")
+    padded = image.copyMakeBorder(NDArray(g), 1, 1, 1, 1, value=0)
+    assert padded.shape == (6, 7)
+
+    with pytest.raises(Exception):
+        image.random_size_crop(NDArray(onp.ones((8, 8, 3), "uint8")),
+                               (4, 4), (0.5, 1.0), (1.0, 1.0),
+                               ration=(1.0, 1.0))
