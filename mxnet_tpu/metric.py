@@ -9,7 +9,7 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "Perplexity",
-           "PearsonCorrelation", "Loss", "create"]
+           "PearsonCorrelation", "Loss", "create", "BinaryAccuracy", "Fbeta", "MeanCosineSimilarity", "MeanPairwiseDistance", "PCC"]
 
 _registry = Registry("metric")
 register = _registry.register
@@ -143,6 +143,7 @@ class F1(EvalMetric):
     def __init__(self, name="f1", average="macro", threshold=0.5, **kwargs):
         self.average = average
         self.threshold = threshold
+        self.beta = 1.0  # F1 is F-beta at beta=1 (Fbeta overrides)
         super().__init__(name, **kwargs)
 
     def reset(self):
@@ -166,8 +167,10 @@ class F1(EvalMetric):
     def get(self):
         prec = self.tp / (self.tp + self.fp) if self.tp + self.fp else 0.0
         rec = self.tp / (self.tp + self.fn) if self.tp + self.fn else 0.0
-        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
-        return self.name, f1
+        b2 = self.beta * self.beta
+        denom = b2 * prec + rec
+        score = (1 + b2) * prec * rec / denom if denom else 0.0
+        return self.name, score
 
 
 @register
@@ -344,3 +347,122 @@ def np_metric(name=None, **kwargs):
         return CustomMetric(f, name or f.__name__, **kwargs)
 
     return decorator
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Accuracy of thresholded scores against 0/1 labels (reference:
+    gluon/metric.py BinaryAccuracy)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        self.threshold = threshold
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _np(label).ravel().astype(bool)
+            pred = _np(pred).ravel() > self.threshold
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += label.size
+
+
+@register
+class Fbeta(F1):
+    """F-beta score: recall weighted ``beta``× against precision
+    (reference: gluon/metric.py Fbeta); beta=1 reduces to F1."""
+
+    def __init__(self, name="fbeta", beta=1.0, average="macro",
+                 threshold=0.5, **kwargs):
+        super().__init__(name=name, average=average, threshold=threshold,
+                         **kwargs)
+        self.beta = beta  # the shared F-beta formula lives on F1.get
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (reference:
+    gluon/metric.py MeanCosineSimilarity)."""
+
+    def __init__(self, name="cos_sim", eps=1e-12, **kwargs):
+        self.eps = eps
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            a, b = _np(label), _np(pred)
+            num = (a * b).sum(axis=-1)
+            den = onp.sqrt((a * a).sum(axis=-1)) * \
+                onp.sqrt((b * b).sum(axis=-1))
+            sim = num / onp.maximum(den, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between label/pred vectors (reference:
+    gluon/metric.py MeanPairwiseDistance)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        self.p = p
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            diff = onp.abs(_np(label) - _np(pred)) ** self.p
+            dist = diff.sum(axis=-1) ** (1.0 / self.p)
+            self.sum_metric += float(dist.sum())
+            self.num_inst += dist.size
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Matthews/Pearson correlation from a running K×K
+    confusion matrix (reference: gluon/metric.py PCC:1597)."""
+
+    def __init__(self, name="pcc", **kwargs):
+        self.conf = onp.zeros((0, 0), dtype=onp.float64)
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self.conf = onp.zeros((0, 0), dtype=onp.float64)
+
+    def _grow(self, k):
+        if k > self.conf.shape[0]:
+            new = onp.zeros((k, k), dtype=onp.float64)
+            old = self.conf.shape[0]
+            new[:old, :old] = self.conf
+            self.conf = new
+
+    def update(self, labels, preds):
+        labels, preds = _as_lists(labels, preds)
+        for label, pred in zip(labels, preds):
+            lab = _np(label).ravel().astype(int)
+            pr = _np(pred)
+            if pr.ndim > 1:
+                pr = pr.argmax(-1).ravel()
+            elif onp.issubdtype(pr.dtype, onp.floating):
+                pr = (pr.ravel() > 0.5).astype(int)  # scores, like MCC
+            else:
+                pr = pr.ravel().astype(int)
+            k = int(max(lab.max(initial=0), pr.max(initial=0))) + 1
+            self._grow(k)
+            onp.add.at(self.conf, (lab, pr), 1)
+            self.num_inst += lab.size
+
+    def get(self):
+        c = self.conf
+        if not c.size or c.sum() == 0:
+            return self.name, 0.0
+        n = c.sum()
+        t = c.sum(axis=1)  # true counts per class
+        p = c.sum(axis=0)  # predicted counts per class
+        cov_tp = onp.trace(c) * n - (t * p).sum()
+        cov_tt = n * n - (t * t).sum()
+        cov_pp = n * n - (p * p).sum()
+        denom = onp.sqrt(cov_tt * cov_pp)
+        return self.name, float(cov_tp / denom) if denom else 0.0

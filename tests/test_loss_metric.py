@@ -137,3 +137,48 @@ def test_custom_metric():
     m = metric.create(lambda label, pred: float(onp.sum(label == pred)))
     m.update(np.array([1, 2]), np.array([1, 3]))
     assert m.get()[1] == 1.0
+
+
+def test_extended_metrics_parity():
+    """BinaryAccuracy / Fbeta / MeanCosineSimilarity / MeanPairwiseDistance
+    / PCC (reference: gluon/metric.py additions)."""
+    from mxnet_tpu import metric
+
+    ba = metric.BinaryAccuracy(threshold=0.5)
+    ba.update(np.array([1, 0, 1, 0]), np.array([0.9, 0.2, 0.3, 0.1]))
+    assert ba.get()[1] == 0.75
+
+    fb = metric.Fbeta(beta=2.0)
+    # asymmetric: tp=1, fp=2, fn=0 -> prec=1/3, rec=1
+    fb.update(np.array([1, 0, 0]), np.array([0.9, 0.8, 0.7]))
+    # F2 = 5*prec*rec / (4*prec + rec) = (5/3)/(7/3) = 5/7
+    assert abs(fb.get()[1] - 5.0 / 7.0) < 1e-6
+    f1c = metric.F1()
+    f1c.update(np.array([1, 0, 0]), np.array([0.9, 0.8, 0.7]))
+    assert abs(f1c.get()[1] - 0.5) < 1e-6  # 2*(1/3)/(4/3)
+
+    cs = metric.MeanCosineSimilarity()
+    cs.update(np.array([[1.0, 0.0]]), np.array([[1.0, 0.0]]))
+    cs.update(np.array([[1.0, 0.0]]), np.array([[0.0, 1.0]]))
+    assert abs(cs.get()[1] - 0.5) < 1e-6
+
+    mpd = metric.MeanPairwiseDistance()
+    mpd.update(np.array([[0.0, 0.0]]), np.array([[3.0, 4.0]]))
+    assert abs(mpd.get()[1] - 5.0) < 1e-6
+
+    pcc = metric.PCC()
+    # perfect 3-class prediction -> 1.0
+    pcc.update(np.array([0, 1, 2, 1]), np.array([0, 1, 2, 1]))
+    assert abs(pcc.get()[1] - 1.0) < 1e-6
+    pcc.reset()
+    # PCC equals MCC for the binary case
+    lab = onp.array([1, 1, 0, 0, 1, 0, 1, 0])
+    pr = onp.array([1, 0, 0, 1, 1, 0, 0, 0])
+    # feed PCC float SCORES: 1-D floats threshold at 0.5 like MCC
+    pcc.update(np.array(lab), np.array(pr.astype("float64") * 0.9 + 0.05))
+    mcc = metric.MCC()
+    scores = onp.stack([1.0 - pr, pr.astype("float64")], axis=1)
+    mcc.update(np.array(lab), np.array(scores))
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-6
+    # created via the registry too
+    assert metric.create("pcc").get()[0] == "pcc"
