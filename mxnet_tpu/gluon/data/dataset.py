@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from ...base import MXNetError
 
-__all__ = ["Dataset", "SimpleDataset", "ArrayDataset"]
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
 
 
 class Dataset:
@@ -140,3 +140,48 @@ class ArrayDataset(Dataset):
         if len(self._arrays) == 1:
             return self._arrays[0][idx]
         return tuple(a[idx] for a in self._arrays)
+
+
+class RecordFileDataset(Dataset):
+    """Raw records of a RecordIO .rec file (reference:
+    gluon/data/dataset.py RecordFileDataset:390); each sample is the
+    record's bytes. The .idx sidecar with the same stem is required.
+
+    Picklable for process DataLoader workers: the open reader (ctypes
+    handles) is dropped on __getstate__ and reopened lazily in the worker
+    (the reference implements the same close/reopen dance for fork).
+    """
+
+    def __init__(self, filename):
+        import os
+
+        self.idx_file = os.path.splitext(filename)[0] + ".idx"
+        self.filename = filename
+        if not os.path.exists(self.idx_file):
+            raise MXNetError(
+                f"RecordFileDataset: index sidecar {self.idx_file!r} not "
+                "found — a silent empty dataset would train on nothing")
+        self._record = None
+        if len(self._reader().keys) == 0:
+            raise MXNetError(
+                f"RecordFileDataset: {filename!r} has no indexed records")
+
+    def _reader(self):
+        if self._record is None:
+            from ...recordio import MXIndexedRecordIO
+
+            self._record = MXIndexedRecordIO(self.idx_file, self.filename,
+                                             "r")
+        return self._record
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_record"] = None  # reopen in the receiving process
+        return state
+
+    def __getitem__(self, idx):
+        rec = self._reader()
+        return rec.read_idx(rec.keys[idx])
+
+    def __len__(self):
+        return len(self._reader().keys)

@@ -14,11 +14,12 @@ import struct
 
 import numpy as onp
 
-from ..dataset import Dataset
+from ..dataset import Dataset, RecordFileDataset
+from ....base import MXNetError
 from ....ndarray.ndarray import NDArray
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
-           "ImageFolderDataset"]
+           "ImageFolderDataset", "ImageRecordDataset", "ImageListDataset"]
 
 
 def _synthetic_images(n, shape, num_classes, seed):
@@ -195,3 +196,73 @@ class ImageFolderDataset(Dataset):
 
     def __len__(self):
         return len(self.items)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """(image, label) samples from a packed RecordIO file (reference:
+    vision/datasets.py ImageRecordDataset:238): records are
+    recordio.pack_img output; images decode via mx.image.imdecode."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....image import imdecode
+        from ....recordio import unpack
+
+        record = super().__getitem__(idx)
+        header, img_bytes = unpack(record)
+        img = imdecode(img_bytes, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageListDataset(Dataset):
+    """(image, label) samples from an .lst file or an in-memory list
+    (reference: vision/datasets.py ImageListDataset): entries are
+    ``key\\tlabel...\\tpath`` lines or ``[label..., path]`` lists."""
+
+    def __init__(self, root=".", imglist=None, flag=1):
+        import os
+
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._items = []  # (label ndarray, abs path)
+        if isinstance(imglist, str):
+            with open(os.path.join(self._root, imglist)) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = onp.asarray([float(v) for v in parts[1:-1]],
+                                        "float32")
+                    self._items.append(
+                        (label, os.path.join(self._root, parts[-1])))
+        elif isinstance(imglist, (list, tuple)):
+            for entry in imglist:
+                if not isinstance(entry[-1], str):
+                    raise MXNetError(
+                        "imglist entries must end with the image path")
+                label = onp.asarray(
+                    entry[:-1] if len(entry) > 2 else [entry[0]],
+                    "float32").reshape(-1)
+                self._items.append(
+                    (label, os.path.join(self._root, entry[-1])))
+        else:
+            raise MXNetError(
+                f"imglist must be a filename or list, got {type(imglist)}")
+
+    def __getitem__(self, idx):
+        from ....image import imread
+
+        label, path = self._items[idx]
+        img = imread(path, self._flag)
+        out_label = label[0] if label.size == 1 else label
+        return img, out_label
+
+    def __len__(self):
+        return len(self._items)

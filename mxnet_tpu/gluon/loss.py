@@ -10,7 +10,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "HuberLoss",
            "SigmoidBinaryCrossEntropyLoss", "SigmoidBCELoss",
            "SoftmaxCrossEntropyLoss", "SoftmaxCELoss", "KLDivLoss", "CTCLoss",
            "HingeLoss", "SquaredHingeLoss", "LogisticLoss",
-           "TripletLoss", "CosineEmbeddingLoss"]
+           "TripletLoss", "CosineEmbeddingLoss", "PoissonNLLLoss", "SDMLLoss"]
 
 
 def _apply_weighting(loss, weight=None, sample_weight=None):
@@ -226,3 +226,58 @@ class CosineEmbeddingLoss(Loss):
         loss = _np.where(label == 1, 1.0 - sim,
                          _np.maximum(sim - self._margin, 0.0))
         return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (reference: gluon/loss.py
+    PoissonNLLLoss:~850): with ``from_logits`` the rate is exp(pred);
+    ``compute_full`` adds the Stirling approximation term for targets > 1.
+    """
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, target, sample_weight=None, epsilon=1e-08):
+        target = _reshape_like(pred, target)
+        if self._from_logits:
+            loss = _np.exp(pred) - target * pred
+        else:
+            loss = pred - target * _np.log(pred + epsilon)
+        if self._compute_full:
+            import math
+
+            stirling = target * _np.log(target + epsilon) - target + \
+                0.5 * _np.log(2 * (target + epsilon) * math.pi)
+            loss = loss + _np.where(target > 1, stirling,
+                                    _np.zeros_like(stirling))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class SDMLLoss(Loss):
+    """Smoothed Deep Metric Learning loss (reference: gluon/loss.py
+    SDMLLoss:997, Bonadiman et al. 2019): aligned batches x1/x2 form
+    positive pairs, the rest of the minibatch serves as smoothed
+    negatives; KL between softmax(-pairwise_distances) and the smoothed
+    identity."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self.smoothing_parameter = smoothing_parameter
+        self.kl_loss = KLDivLoss(from_logits=True)
+
+    def forward(self, x1, x2):
+        b = x1.shape[0]
+        d = _np.square(x1.reshape(b, 1, -1) - x2.reshape(1, b, -1)).sum(
+            axis=2)
+        eye = _np.eye(b)
+        labels = eye * (1 - self.smoothing_parameter) + \
+            (_np.ones_like(eye) - eye) * self.smoothing_parameter / (b - 1)
+        logp = npx.log_softmax(-d, axis=1)
+        # kl_loss averages over the label axis; scale back to a sum (the
+        # reference multiplies by the label count for the same reason)
+        return self.kl_loss(logp, labels) * b

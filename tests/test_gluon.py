@@ -307,3 +307,79 @@ def test_transforms_random_apply():
     assert flipped
     assert T.HybridCompose is T.Compose
     assert T.HybridRandomApply is T.RandomApply
+
+
+def test_image_record_and_list_datasets(tmp_path):
+    """RecordFileDataset / ImageRecordDataset / ImageListDataset
+    (reference: gluon/data/dataset.py:390, vision/datasets.py:238+)."""
+    from mxnet_tpu import image, recordio
+    from mxnet_tpu.gluon.data import RecordFileDataset
+    from mxnet_tpu.gluon.data.vision.datasets import (ImageListDataset,
+                                                      ImageRecordDataset)
+
+    prefix = str(tmp_path / "pack")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(4):
+        img = onp.full((8, 8, 3), 10 * i, dtype="uint8")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    w.close()
+
+    raw = RecordFileDataset(prefix + ".rec")
+    assert len(raw) == 4 and isinstance(raw[0], bytes)
+
+    ds = ImageRecordDataset(prefix + ".rec")
+    assert len(ds) == 4
+    img0, label0 = ds[2]
+    assert float(label0) == 2.0
+    assert img0.shape[2] == 3 and abs(float(img0.asnumpy().mean()) - 20) < 6
+
+    # list dataset from an in-memory list and a .lst file
+    import os
+    pngs = []
+    for i in range(2):
+        arr = onp.full((4, 5, 3), 30 * i, "uint8")
+        path = tmp_path / f"im{i}.png"
+        image.imwrite(str(path), arr) if hasattr(image, "imwrite") else \
+            __import__("PIL.Image", fromlist=["Image"]).fromarray(arr).save(
+                str(path))
+        pngs.append(path.name)
+    lst = ImageListDataset(root=str(tmp_path),
+                           imglist=[(0.0, pngs[0]), (1.0, pngs[1])])
+    im, lab = lst[1]
+    assert float(lab) == 1.0 and im.shape[:2] == (4, 5)
+    (tmp_path / "files.lst").write_text(
+        f"0\t0.0\t{pngs[0]}\n1\t1.0\t{pngs[1]}\n")
+    lst2 = ImageListDataset(root=str(tmp_path), imglist="files.lst")
+    assert len(lst2) == 2 and float(lst2[0][1]) == 0.0
+
+
+def _rec_to_float(sample):
+    return onp.asarray(sample[0], "float32"), sample[1]
+
+
+def test_record_dataset_process_workers_and_guards(tmp_path):
+    """RecordFileDataset pickles for spawned workers; missing .idx raises."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+
+    prefix = str(tmp_path / "p")
+    w = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(8):
+        img = onp.full((6, 6, 3), 5 * i, dtype="uint8")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    w.close()
+    ds = ImageRecordDataset(prefix + ".rec").transform(_rec_to_float)
+    # pickles and round-trips through spawned worker processes
+    import pickle
+
+    pickle.loads(pickle.dumps(ds))
+    out = [b for b in DataLoader(ds, batch_size=4, num_workers=1)]
+    assert len(out) == 2 and out[0][0].shape == (4, 6, 6, 3)
+
+    import os
+    os.remove(prefix + ".idx")
+    with pytest.raises(MXNetError, match="idx"):
+        ImageRecordDataset(prefix + ".rec")

@@ -182,3 +182,34 @@ def test_extended_metrics_parity():
     assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-6
     # created via the registry too
     assert metric.create("pcc").get()[0] == "pcc"
+
+
+def test_poisson_and_sdml_losses():
+    """PoissonNLLLoss / SDMLLoss (reference: gluon/loss.py:850,997)."""
+    from mxnet_tpu import gluon
+
+    # from_logits: loss = exp(pred) - target*pred
+    pl = gluon.loss.PoissonNLLLoss(from_logits=True)
+    pred = onp.array([[0.0, 1.0]], "float32")
+    tgt = onp.array([[1.0, 2.0]], "float32")
+    want = (onp.exp(pred) - tgt * pred).mean()
+    got = float(pl(np.array(pred), np.array(tgt)).asnumpy())
+    assert abs(got - want) < 1e-5
+    # compute_full adds Stirling only for target > 1
+    pf = gluon.loss.PoissonNLLLoss(from_logits=False, compute_full=True)
+    got2 = float(pf(np.array([[2.0, 2.0]]),
+                    np.array([[0.5, 3.0]])).asnumpy())
+    base = (2.0 - 0.5 * onp.log(2.0 + 1e-8) +
+            2.0 - 3.0 * onp.log(2.0 + 1e-8)) / 2
+    stir = (3.0 * onp.log(3.0 + 1e-8) - 3.0 +
+            0.5 * onp.log(2 * (3.0 + 1e-8) * onp.pi)) / 2
+    assert abs(got2 - (base + stir)) < 1e-4
+
+    # SDML: aligned identical batches -> much smaller loss than misaligned
+    sd = gluon.loss.SDMLLoss(smoothing_parameter=0.1)
+    rng = onp.random.RandomState(5)
+    x = rng.randn(6, 8).astype("float32")
+    aligned = float(sd(np.array(x), np.array(x)).asnumpy().mean())
+    shuffled = float(sd(np.array(x),
+                        np.array(onp.roll(x, 1, axis=0))).asnumpy().mean())
+    assert aligned < shuffled
