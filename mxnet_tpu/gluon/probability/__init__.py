@@ -6,7 +6,11 @@ jax.scipy.stats where available; sampling draws from the framework PRNG
 (mx.random) so mx.random.seed governs reproducibility; reparameterized
 samples (sample_n with gradients) use the explicit-key pattern.
 """
-from .distributions import (Distribution, Normal, Bernoulli, Categorical,
+from .distributions import (Beta, Binomial, Cauchy, Chi2, Geometric,
+                            Gumbel, HalfCauchy, HalfNormal, Independent,
+                            NegativeBinomial, OneHotCategorical, Pareto,
+                            StudentT, TransformedDistribution, Weibull,
+                            Distribution, Normal, Bernoulli, Categorical,
                             Gamma, Exponential, Poisson, Uniform, Laplace,
                             MultivariateNormal, kl_divergence,
                             register_kl)

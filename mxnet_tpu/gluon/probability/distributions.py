@@ -12,7 +12,11 @@ from ... import random as _random
 
 __all__ = ["Distribution", "Normal", "Bernoulli", "Categorical", "Gamma",
            "Exponential", "Poisson", "Uniform", "Laplace",
-           "MultivariateNormal", "kl_divergence", "register_kl"]
+           "MultivariateNormal", "Beta", "Cauchy", "HalfCauchy",
+           "HalfNormal", "Chi2", "StudentT", "Gumbel", "Weibull", "Pareto",
+           "Geometric", "Binomial", "NegativeBinomial", "OneHotCategorical",
+           "Independent", "TransformedDistribution", "kl_divergence",
+           "register_kl"]
 
 
 def _nd(x):
@@ -363,3 +367,427 @@ def _kl_cat_cat(p, q):
 def _kl_exp_exp(p, q):
     r = p.scale / q.scale
     return -_np.log(r) + r - 1
+
+
+def _gammaln(x):
+    from ... import numpy_extension as npx
+
+    return npx.gammaln(x)
+
+
+def _batched(size, *params):
+    """size + broadcasted parameter batch shape, so array-parameter
+    distributions draw independent noise per batch element."""
+    base = ()
+    for a in params:
+        shp = getattr(a, "shape", ())
+        base = onp.broadcast_shapes(base, tuple(shp))
+    if size is None:
+        return base or None
+    size = (size,) if isinstance(size, int) else tuple(size)
+    return size + base
+
+
+class Beta(Distribution):
+    """Beta(α, β) (reference: distributions/beta.py)."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _nd(alpha)
+        self.beta = _nd(beta)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        a, b = self.alpha, self.beta
+        logbeta = _gammaln(a) + _gammaln(b) - _gammaln(a + b)
+        return (a - 1) * _np.log(value) + (b - 1) * _np.log1p(-value) - \
+            logbeta
+
+    def sample(self, size=None):
+        # ratio-of-gammas (reparameterized through the gamma sampler)
+        shp = _batched(size, self.alpha, self.beta)
+        x = _random.gamma(self.alpha, 1.0, size=shp)
+        y = _random.gamma(self.beta, 1.0, size=shp)
+        return x / (x + y)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1))
+
+
+class Cauchy(Distribution):
+    """Cauchy(loc, scale) (reference: distributions/cauchy.py)."""
+
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc = _nd(loc)
+        self.scale = _nd(scale)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        z = (value - self.loc) / self.scale
+        return -_np.log(math.pi * self.scale * (1 + z * z))
+
+    def sample(self, size=None):
+        u = _random.uniform(0.0, 1.0, size=_batched(size, self.loc,
+                                                    self.scale))
+        return self.loc + self.scale * _np.tan(
+            math.pi * (u - _np.full_like(u, 0.5)))
+
+    @property
+    def mean(self):
+        return _np.full_like(self.loc, onp.nan)  # undefined
+
+    @property
+    def variance(self):
+        return _np.full_like(self.loc, onp.nan)
+
+    def entropy(self):
+        return _np.log(4 * math.pi * self.scale)
+
+
+class HalfCauchy(Cauchy):
+    """|Cauchy(0, scale)| (reference: distributions/half_cauchy.py)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(0.0, scale)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        lp = super().log_prob(value) + math.log(2.0)
+        return _np.where(value >= 0, lp, _np.full_like(lp, -onp.inf))
+
+    def sample(self, size=None):
+        return _np.abs(super().sample(size))
+
+    def entropy(self):
+        return _np.log(2 * math.pi * self.scale)
+
+
+class HalfNormal(Normal):
+    """|Normal(0, scale)| (reference: distributions/half_normal.py)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(0.0, scale)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        lp = super().log_prob(value) + math.log(2.0)
+        return _np.where(value >= 0, lp, _np.full_like(lp, -onp.inf))
+
+    def sample(self, size=None):
+        return _np.abs(super().sample(size))
+
+    def entropy(self):
+        return super().entropy() - math.log(2.0)
+
+    @property
+    def mean(self):
+        return self.scale * math.sqrt(2.0 / math.pi)
+
+    @property
+    def variance(self):
+        return self.scale ** 2 * (1 - 2.0 / math.pi)
+
+
+class Chi2(Gamma):
+    """Chi-squared with df degrees of freedom = Gamma(df/2, 2)
+    (reference: distributions/chi2.py)."""
+
+    def __init__(self, df):
+        self.df = _nd(df)
+        super().__init__(self.df / 2.0, 2.0)
+
+
+class StudentT(Distribution):
+    """Student's t (reference: distributions/studentT.py)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _nd(df)
+        self.loc = _nd(loc)
+        self.scale = _nd(scale)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        d = self.df
+        z = (value - self.loc) / self.scale
+        return (_gammaln((d + 1) / 2) - _gammaln(d / 2) -
+                0.5 * _np.log(d * math.pi) - _np.log(self.scale) -
+                (d + 1) / 2 * _np.log1p(z * z / d))
+
+    def sample(self, size=None):
+        # normal / sqrt(chi2/df)
+        shp = _batched(size, self.df, self.loc, self.scale)
+        z = _random.normal(size=shp)
+        g = _random.gamma(self.df / 2.0, 2.0, size=shp)
+        return self.loc + self.scale * z / _np.sqrt(g / self.df)
+
+    @property
+    def mean(self):
+        return _np.where(self.df > 1, self.loc,
+                         _np.full_like(self.loc, onp.nan))
+
+    @property
+    def variance(self):
+        d = self.df
+        v = d / (d - 2)
+        return _np.where(d > 2, v * self.scale ** 2,
+                         _np.full_like(self.scale, onp.nan))
+
+
+class Gumbel(Distribution):
+    """Gumbel(loc, scale) (reference: distributions/gumbel.py)."""
+
+    def __init__(self, loc=0.0, scale=1.0):
+        self.loc = _nd(loc)
+        self.scale = _nd(scale)
+
+    def log_prob(self, value):
+        z = (_nd(value) - self.loc) / self.scale
+        return -(z + _np.exp(-z)) - _np.log(self.scale)
+
+    def sample(self, size=None):
+        u = _random.uniform(1e-12, 1.0, size=_batched(size, self.loc,
+                                                      self.scale))
+        return self.loc - self.scale * _np.log(-_np.log(u))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.5772156649015329  # Euler-gamma
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6) * self.scale ** 2
+
+    def entropy(self):
+        return _np.log(self.scale) + 1 + 0.5772156649015329
+
+
+class Weibull(Distribution):
+    """Weibull(concentration k, scale λ) (reference:
+    distributions/weibull.py)."""
+
+    def __init__(self, concentration, scale=1.0):
+        self.concentration = _nd(concentration)
+        self.scale = _nd(scale)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        k, lam = self.concentration, self.scale
+        z = value / lam
+        return _np.log(k / lam) + (k - 1) * _np.log(z) - z ** k
+
+    def sample(self, size=None):
+        u = _random.uniform(1e-12, 1.0, size=_batched(
+            size, self.concentration, self.scale))
+        return self.scale * (-_np.log(u)) ** (1.0 / self.concentration)
+
+    @property
+    def mean(self):
+        return self.scale * _np.exp(_gammaln(1 + 1.0 / self.concentration))
+
+    @property
+    def variance(self):
+        g1 = _np.exp(_gammaln(1 + 1.0 / self.concentration))
+        g2 = _np.exp(_gammaln(1 + 2.0 / self.concentration))
+        return self.scale ** 2 * (g2 - g1 * g1)
+
+
+class Pareto(Distribution):
+    """Pareto(α, scale x_m) (reference: distributions/pareto.py)."""
+
+    def __init__(self, alpha, scale=1.0):
+        self.alpha = _nd(alpha)
+        self.scale = _nd(scale)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        return _np.log(self.alpha) + self.alpha * _np.log(self.scale) - \
+            (self.alpha + 1) * _np.log(value)
+
+    def sample(self, size=None):
+        u = _random.uniform(1e-12, 1.0, size=_batched(size, self.alpha,
+                                                      self.scale))
+        return self.scale * u ** (-1.0 / self.alpha)
+
+    @property
+    def mean(self):
+        a = self.alpha
+        return _np.where(a > 1, a * self.scale / (a - 1),
+                         _np.full_like(self.scale, onp.inf))
+
+    @property
+    def variance(self):
+        a = self.alpha
+        v = self.scale ** 2 * a / ((a - 1) ** 2 * (a - 2))
+        return _np.where(a > 2, v, _np.full_like(self.scale, onp.inf))
+
+
+class Geometric(Distribution):
+    """Geometric(p): failures before the first success, support {0,1,...}
+    (reference: distributions/geometric.py)."""
+
+    def __init__(self, prob):
+        self.prob = _nd(prob)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        return value * _np.log1p(-self.prob) + _np.log(self.prob)
+
+    def sample(self, size=None):
+        # support {0, 1, ...} (failures before success — the reference
+        # gluon convention; mx.random.geometric counts trials from 1)
+        u = _random.uniform(1e-12, 1.0, size=_batched(size, self.prob))
+        return _np.floor(_np.log(u) / _np.log1p(-self.prob))
+
+    @property
+    def mean(self):
+        return (1 - self.prob) / self.prob
+
+    @property
+    def variance(self):
+        return (1 - self.prob) / self.prob ** 2
+
+
+class Binomial(Distribution):
+    """Binomial(n, p) (reference: distributions/binomial.py)."""
+
+    has_grad = False
+
+    def __init__(self, n, prob):
+        self.n = _nd(n)
+        self.prob = _nd(prob)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        n, p = self.n, self.prob
+        logchoose = _gammaln(n + 1) - _gammaln(value + 1) - \
+            _gammaln(n - value + 1)
+        return logchoose + value * _np.log(p) + (n - value) * _np.log1p(-p)
+
+    def sample(self, size=None):
+        out = _random.binomial(self.n._data, self.prob._data,
+                               size=_batched(size, self.n, self.prob))
+        return out.astype("float32")
+
+    @property
+    def mean(self):
+        return self.n * self.prob
+
+    @property
+    def variance(self):
+        return self.n * self.prob * (1 - self.prob)
+
+
+class NegativeBinomial(Distribution):
+    """NegativeBinomial(r, p): failures before the r-th success
+    (reference: distributions/negative_binomial.py)."""
+
+    has_grad = False
+
+    def __init__(self, n, prob):
+        self.n = _nd(n)
+        self.prob = _nd(prob)
+
+    def log_prob(self, value):
+        value = _nd(value)
+        r, p = self.n, self.prob
+        logchoose = _gammaln(value + r) - _gammaln(value + 1) - _gammaln(r)
+        return logchoose + r * _np.log(p) + value * _np.log1p(-p)
+
+    def sample(self, size=None):
+        # gamma-Poisson mixture, fully on the framework PRNG
+        lam = _random.gamma(self.n, (1 - self.prob) / self.prob,
+                            size=_batched(size, self.n, self.prob))
+        import jax
+
+        data = jax.random.poisson(_random._next_key(), lam._data)
+        return _np.array(data).astype("float32")
+
+    @property
+    def mean(self):
+        return self.n * (1 - self.prob) / self.prob
+
+    @property
+    def variance(self):
+        return self.n * (1 - self.prob) / self.prob ** 2
+
+
+class OneHotCategorical(Distribution):
+    """Categorical with one-hot sample encoding (reference:
+    distributions/one_hot_categorical.py)."""
+
+    has_grad = False
+
+    def __init__(self, prob=None, logit=None, num_events=None):
+        self._cat = Categorical(prob=prob, logit=logit)
+        self.num_events = num_events or int(self._cat.prob_.shape[-1])
+
+    @property
+    def prob(self):
+        return self._cat.prob_
+
+    def log_prob(self, value):
+        idx = _nd(value).asnumpy().argmax(-1)
+        return self._cat.log_prob(_np.array(idx))
+
+    def sample(self, size=None):
+        idx = self._cat.sample(size).asnumpy().astype(int)
+        eye = onp.eye(self.num_events, dtype="float32")
+        return _np.array(eye[idx])
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims: log_prob sums over
+    them (reference: distributions/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base_dist = base
+        self.ndims = int(reinterpreted_batch_ndims)
+
+    def log_prob(self, value):
+        lp = self.base_dist.log_prob(value)
+        for _ in range(self.ndims):
+            lp = lp.sum(-1)
+        return lp
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of f(X): log_prob via the change-of-variables formula
+    given paired (forward, inverse, log_abs_det_jacobian) callables
+    (reference: distributions/transformed_distribution.py)."""
+
+    def __init__(self, base, transform_fn, inverse_fn, log_det_fn):
+        self.base_dist = base
+        self._fwd = transform_fn
+        self._inv = inverse_fn
+        self._log_det = log_det_fn
+
+    def sample(self, size=None):
+        return self._fwd(self.base_dist.sample(size))
+
+    def log_prob(self, value):
+        x = self._inv(_nd(value))
+        return self.base_dist.log_prob(x) - self._log_det(x)

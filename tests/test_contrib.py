@@ -393,3 +393,120 @@ def test_onnx_fresh_process_roundtrip(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     got = onp.asarray(json.loads(proc.stdout.strip().splitlines()[-1]))
     assert_almost_equal(got, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_probability_distribution_breadth():
+    """The round-4 distribution additions: log_prob against scipy-free
+    closed forms, sampling moments within tolerance."""
+    from mxnet_tpu.gluon import probability as P
+
+    rng_n = 20000
+
+    # Beta(2,3): mean 0.4, var 0.04
+    b = P.Beta(2.0, 3.0)
+    assert abs(float(b.mean) - 0.4) < 1e-6
+    s = b.sample((rng_n,)).asnumpy()
+    assert abs(s.mean() - 0.4) < 0.02 and (s >= 0).all() and (s <= 1).all()
+    lp = float(b.log_prob(np.array(0.5)).asnumpy())
+    import math as m
+    want = m.log(0.5 ** 1 * 0.5 ** 2 / (m.gamma(2) * m.gamma(3) /
+                                        m.gamma(5)))
+    assert abs(lp - want) < 1e-4
+
+    # Chi2(4) = Gamma(2, 2): mean 4, var 8
+    c2 = P.Chi2(4.0)
+    assert abs(float(c2.mean) - 4.0) < 1e-5
+    assert abs(float(c2.variance) - 8.0) < 1e-5
+
+    # StudentT(df=10): variance df/(df-2)
+    st = P.StudentT(10.0)
+    assert abs(float(st.variance) - 1.25) < 1e-5
+    s = st.sample((rng_n,)).asnumpy()
+    assert abs(s.mean()) < 0.05
+
+    # Gumbel: mean loc + gamma*scale
+    g = P.Gumbel(1.0, 2.0)
+    s = g.sample((rng_n,)).asnumpy()
+    assert abs(s.mean() - float(g.mean)) < 0.1
+
+    # Weibull(k=1, lam=2) == Exponential(scale 2)
+    w = P.Weibull(1.0, 2.0)
+    s = w.sample((rng_n,)).asnumpy()
+    assert abs(s.mean() - 2.0) < 0.1
+    assert abs(float(w.log_prob(np.array(1.0)).asnumpy()) -
+               (m.log(0.5) - 0.5)) < 1e-5
+
+    # Pareto(3, 1): mean 1.5
+    pa = P.Pareto(3.0, 1.0)
+    s = pa.sample((rng_n,)).asnumpy()
+    assert abs(s.mean() - 1.5) < 0.1 and (s >= 1).all()
+
+    # Geometric(0.25): mean 3
+    ge = P.Geometric(0.25)
+    s = ge.sample((rng_n,)).asnumpy()
+    assert abs(s.mean() - 3.0) < 0.15 and (s >= 0).all()
+
+    # Binomial(8, 0.5): mean 4; exact pmf at k=4
+    bi = P.Binomial(8.0, 0.5)
+    assert abs(float(bi.log_prob(np.array(4.0)).asnumpy()) -
+               m.log(70 / 256)) < 1e-4
+    s = bi.sample((rng_n,)).asnumpy()
+    assert abs(s.mean() - 4.0) < 0.1
+
+    # NegativeBinomial(r=3, p=0.5): mean 3
+    nb = P.NegativeBinomial(3.0, 0.5)
+    assert abs(float(nb.mean) - 3.0) < 1e-5
+    assert abs(float(nb.log_prob(np.array(0.0)).asnumpy()) -
+               m.log(0.125)) < 1e-4
+
+    # HalfNormal folds mass: all samples nonnegative, doubled density
+    hn = P.HalfNormal(1.0)
+    assert (hn.sample((500,)).asnumpy() >= 0).all()
+    n01 = P.Normal(0.0, 1.0)
+    assert abs(float(hn.log_prob(np.array(0.3)).asnumpy()) -
+               (float(n01.log_prob(np.array(0.3)).asnumpy()) +
+                m.log(2))) < 1e-5
+
+    # OneHotCategorical samples are one-hot rows
+    oh = P.OneHotCategorical(prob=np.array([0.2, 0.3, 0.5]))
+    s = oh.sample((64,)).asnumpy()
+    assert s.shape == (64, 3) and (s.sum(-1) == 1).all()
+
+    # Independent sums trailing dims of log_prob
+    ind = P.Independent(P.Normal(np.zeros((4,)), np.ones((4,))), 1)
+    lp = ind.log_prob(np.zeros((4,)))
+    assert lp.ndim == 0 or lp.size == 1
+
+    # TransformedDistribution: exp(Normal) == LogNormal
+    td = P.TransformedDistribution(
+        P.Normal(0.0, 1.0), lambda x: np.exp(x), lambda y: np.log(y),
+        lambda x: x)  # log|d exp(x)/dx| = x
+    lp = float(td.log_prob(np.array(1.0)).asnumpy())
+    want = -0.5 * m.log(2 * m.pi)  # logN pdf at 1.0
+    assert abs(lp - want) < 1e-5
+
+
+def test_distribution_batch_params_independent_draws():
+    """Array-parameter distributions draw independent noise per element
+    and mask out-of-support values."""
+    from mxnet_tpu.gluon import probability as P
+
+    st = P.StudentT(np.array([3.0, 5.0, 10.0]))
+    s = st.sample((64,)).asnumpy()
+    assert s.shape == (64, 3)
+    # columns not perfectly correlated (independent draws)
+    c = onp.corrcoef(s[:, 0], s[:, 1])[0, 1]
+    assert abs(c) < 0.9
+    g = P.Gumbel(np.array([0.0, 1.0, 2.0])).sample((5,))
+    assert g.shape == (5, 3)
+    bi = P.Binomial(np.array([2.0, 8.0]), 0.5).sample((100,)).asnumpy()
+    assert bi.shape == (100, 2) and bi[:, 0].max() <= 2 and \
+        bi[:, 1].max() <= 8
+    hn = P.HalfNormal(1.0)
+    assert float(hn.log_prob(np.array(-0.5)).asnumpy()) == -onp.inf
+    import mxnet_tpu as mx
+    mx.random.seed(11)
+    a = P.NegativeBinomial(3.0, 0.5).sample((50,)).asnumpy()
+    mx.random.seed(11)
+    b = P.NegativeBinomial(3.0, 0.5).sample((50,)).asnumpy()
+    assert (a == b).all()  # framework PRNG governs reproducibility
