@@ -1,10 +1,12 @@
 """Optimizer package (reference: python/mxnet/optimizer/)."""
-from .optimizer import (Optimizer, SGD, Adam, AdamW, NAG, RMSProp, AdaGrad,
+from .optimizer import (GroupAdaGrad,
+                        Optimizer, SGD, Adam, AdamW, NAG, RMSProp, AdaGrad,
                         AdaDelta, Adamax, Nadam, Ftrl, FTML, Signum, LAMB,
                         LARS, LANS, AdaBelief, SGLD, DCASGD, create, register)
 from .updater import Updater, get_updater
 
 __all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "AdaGrad",
            "AdaDelta", "Adamax", "Nadam", "Ftrl", "FTML", "Signum", "LAMB",
+           "GroupAdaGrad",
            "LARS", "LANS", "AdaBelief", "SGLD", "DCASGD", "create", "register",
            "Updater", "get_updater"]

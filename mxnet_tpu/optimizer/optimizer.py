@@ -22,7 +22,8 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "Adam", "AdamW", "NAG", "RMSProp", "AdaGrad",
            "AdaDelta", "Adamax", "Nadam", "Ftrl", "FTML", "Signum", "LAMB",
-           "LARS", "AdaBelief", "SGLD", "DCASGD", "create", "register"]
+           "LARS", "AdaBelief", "SGLD", "DCASGD", "GroupAdaGrad", "create",
+           "register"]
 
 _registry = Registry("optimizer")
 register = _registry.register
@@ -757,3 +758,34 @@ class DCASGD(Optimizer):
 _registry.alias("sgd", "sgd")
 _registry.alias("adam", "adam")
 _registry.alias("adamw", "adamw")
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """Row-grouped AdaGrad (reference: optimizer/contrib.py GroupAdaGrad):
+    one accumulated history scalar per row (embedding-style grouping),
+    update = lr * grad / sqrt(history + eps)."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate, **kwargs)
+        self._eps = epsilon
+
+        def step(w, h, g, lr, wd):
+            g = self._pre(g) + wd * w
+            axes = tuple(range(1, g.ndim)) or None
+            h = h + (jnp.mean(g * g, axis=axes, keepdims=True)
+                     if axes else h * 0 + g * g)
+            return w - lr * g / (jnp.sqrt(h) + epsilon), h
+
+        self._step = _jit_step(step, 2)
+
+    def create_state(self, index, weight):
+        shape = (weight.shape[0],) + (1,) * (len(weight.shape) - 1) \
+            if weight.shape else ()
+        return {"history": NDArray(jnp.zeros(shape, jnp.float32))}
+
+    def _apply(self, w, g, state, lr, wd, t):
+        new_w, h = self._step(w._data, state["history"]._data, g._data,
+                              lr, wd)
+        w._set_data(new_w)
+        state["history"]._set_data(h)

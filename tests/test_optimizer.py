@@ -229,3 +229,33 @@ def test_sparse_grad_lazy_update_false_densifies():
     after = w.asnumpy()
     # all rows decayed, including inactive ones
     assert (after < 1.0).all(), after
+
+
+def test_group_adagrad():
+    """GroupAdaGrad (reference optimizer/contrib.py): per-row history,
+    matches the reference recurrence."""
+    from mxnet_tpu import optimizer
+
+    opt = optimizer.create("groupadagrad", learning_rate=0.1)
+    w = np.array(onp.ones((3, 4), "float32"))
+    g = np.array(onp.arange(12, dtype="float32").reshape(3, 4) / 10)
+    state = opt.create_state(0, w)
+    assert state["history"].shape == (3, 1)
+    w_before = w.asnumpy().copy()
+    opt.update(0, w, g, state)
+    hist = (g.asnumpy() ** 2).mean(axis=1, keepdims=True)
+    want = w_before - 0.1 * g.asnumpy() / (onp.sqrt(hist) + 1e-5)
+    assert_almost_equal(w.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    # a Trainer drives it end to end
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "groupadagrad",
+                       {"learning_rate": 0.05})
+    x = np.array(onp.random.randn(4, 3).astype("float32"))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)
