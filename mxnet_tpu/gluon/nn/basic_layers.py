@@ -19,7 +19,7 @@ __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "Flatten",
            "Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish",
            "SiLU", "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
            "RMSNorm", "Embedding", "Lambda", "HybridLambda", "Identity",
-           "Concatenate", "HybridConcatenate"]
+           "Concatenate", "HybridConcatenate", "BatchNormReLU", "ReflectionPad2D"]
 
 
 class Sequential(Block):
@@ -425,3 +425,57 @@ class HybridConcatenate(Concatenate, HybridBlock):
     def __init__(self, axis=-1):
         HybridBlock.__init__(self)
         self._axis = axis
+
+
+
+class BatchNormReLU(BatchNorm):
+    """BatchNorm fused with ReLU (reference: _contrib_BatchNormWithReLU
+    name parity; XLA fuses the activation into the normalization)."""
+
+    def forward(self, x):
+        return npx.relu(super().forward(x))
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection-pad H/W of NCHW inputs (reference: nn/conv_layers.py
+    ReflectionPad2D). Built from flip+concat so it traces under
+    hybridize (no host round-trip)."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, (tuple, list)):
+            p = tuple(int(v) for v in padding)
+            if len(p) == 8:
+                # reference 8-tuple pad_width spec:
+                # (0,0, 0,0, top,bottom, left,right)
+                p = (p[6], p[7], p[4], p[5])
+            elif len(p) != 4:
+                raise MXNetError(
+                    "ReflectionPad2D takes an int, a (left, right, top, "
+                    "bottom) 4-tuple, or the reference 8-tuple pad_width")
+        else:
+            p = (int(padding),) * 4
+        self._pad = p  # (left, right, top, bottom)
+
+    @staticmethod
+    def _reflect(x, before, after, axis):
+        from ... import np as _np
+
+        parts = []
+        if before:
+            parts.append(_np.flip(
+                npx.slice_axis(x, axis=axis, begin=1, end=before + 1),
+                axis=axis))
+        parts.append(x)
+        if after:
+            n = x.shape[axis]
+            parts.append(_np.flip(
+                npx.slice_axis(x, axis=axis, begin=n - after - 1,
+                               end=n - 1), axis=axis))
+        return parts[0] if len(parts) == 1 else _np.concatenate(parts,
+                                                                axis=axis)
+
+    def forward(self, x):
+        left, right, top, bottom = self._pad
+        x = self._reflect(x, top, bottom, 2)
+        return self._reflect(x, left, right, 3)

@@ -11,12 +11,13 @@ from ...base import MXNetError
 from ..block import HybridBlock
 from ..parameter import Parameter
 from ... import numpy_extension as npx
+from ...ops import apply_op as _apply_op
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
            "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
            "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
            "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
-           "GlobalAvgPool2D", "GlobalAvgPool3D"]
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "DeformableConvolution", "ModulatedDeformableConvolution", "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D"]
 
 
 def _tup(x, n):
@@ -243,3 +244,114 @@ class GlobalAvgPool2D(_GlobalPool):
 class GlobalAvgPool3D(_GlobalPool):
     def __init__(self, layout="NCDHW", **kwargs):
         super().__init__(3, "avg", layout, **kwargs)
+
+
+class DeformableConvolution(_Conv):
+    """Deformable conv v1 layer (reference: nn/conv_layers.py
+    DeformableConvolution:1249): the offset field is produced by an
+    internal regular conv over the same input, then the deformable
+    sampling conv applies ``weight``/``bias`` at the offset taps.
+    Weight/bias/deferred-init/activation handling comes from ``_Conv``."""
+
+    _modulated = False
+
+    def __init__(self, channels, kernel_size=(3, 3), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, weight_initializer=None,
+                 bias_initializer="zeros", offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 dtype="float32", **kwargs):
+        kernel_size = _tup(kernel_size, 2)
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, "NCHW", in_channels=in_channels,
+                         activation=activation, use_bias=use_bias,
+                         weight_initializer=weight_initializer,
+                         bias_initializer=bias_initializer, dtype=dtype,
+                         **kwargs)
+        k = kernel_size[0] * kernel_size[1]
+        per_pos = 3 if self._modulated else 2
+        self._split = 2 * k * num_deformable_group  # offsets before masks
+        self._dg = num_deformable_group
+        self.offset = Conv2D(per_pos * k * num_deformable_group,
+                             kernel_size, strides, padding, dilation,
+                             groups=1, in_channels=in_channels,
+                             use_bias=offset_use_bias,
+                             weight_initializer=offset_weight_initializer,
+                             bias_initializer=offset_bias_initializer,
+                             dtype=dtype)
+
+    def forward(self, x):
+        self._infer(x)
+        offs = self.offset(x)
+        op = ("modulated_deformable_convolution" if self._modulated
+              else "deformable_convolution")
+        args = [x, offs[:, :self._split]] if self._modulated else [x, offs]
+        if self._modulated:
+            args.append(npx.sigmoid(offs[:, self._split:]))
+        args.append(self.weight.data())
+        if self.bias is not None:
+            args.append(self.bias.data())
+        out = _apply_op(op, *args, kernel=self._kernel,
+                        stride=self._stride, dilate=self._dilate,
+                        pad=self._pad, num_filter=self._channels,
+                        num_group=self._groups,
+                        num_deformable_group=self._dg,
+                        no_bias=self.bias is None)
+        if self._activation:
+            out = npx.activation(out, act_type=self._activation)
+        return out
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """Deformable conv v2 (reference: nn/conv_layers.py): the internal
+    conv also predicts per-tap sigmoid masks."""
+
+    _modulated = True
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        self._f = _tup(factor, ndim)
+        self._ndim = ndim
+
+    def forward(self, x):
+        f = self._f
+        n = self._ndim
+        b, c = x.shape[0], x.shape[1]
+        spatial = x.shape[2:]
+        import math as _m
+
+        cf = _m.prod(f)
+        # (B, C*prod(f), *S) -> (B, C, f1.., *S) -> interleave -> upscale
+        out = x.reshape((b, c // cf) + tuple(f) + tuple(spatial))
+        # axes: [0, 1] + for each dim i: spatial_axis(i), factor_axis(i)
+        perm = [0, 1]
+        for i in range(n):
+            perm += [2 + n + i, 2 + i]
+        out = out.transpose(tuple(perm))
+        new_spatial = tuple(s * fi for s, fi in zip(spatial, f))
+        return out.reshape((b, c // cf) + new_spatial)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(B, C·f, W) → (B, C, W·f) (reference: conv_layers.py)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(B, C·f1·f2, H, W) → (B, C, H·f1, W·f2) (reference:
+    conv_layers.py:1693)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(B, C·f1·f2·f3, D, H, W) → (B, C, D·f1, H·f2, W·f3)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

@@ -772,10 +772,10 @@ class GroupAdaGrad(Optimizer):
 
         def step(w, h, g, lr, wd):
             g = self._pre(g) + wd * w
-            axes = tuple(range(1, g.ndim)) or None
-            h = h + (jnp.mean(g * g, axis=axes, keepdims=True)
-                     if axes else h * 0 + g * g)
-            return w - lr * g / (jnp.sqrt(h) + epsilon), h
+            # mean over the non-row axes; axis=() is the identity for 1-D
+            h = h + jnp.mean(g * g, axis=tuple(range(1, g.ndim)),
+                             keepdims=True)
+            return w - lr * g / jnp.sqrt(h + epsilon), h
 
         self._step = _jit_step(step, 2)
 
@@ -788,4 +788,18 @@ class GroupAdaGrad(Optimizer):
         new_w, h = self._step(w._data, state["history"]._data, g._data,
                               lr, wd)
         w._set_data(new_w)
+        state["history"]._set_data(h)
+
+    def _apply_sparse(self, weight, grad, state, lr, wd, t):
+        """Lazy row-sparse path: only the touched rows update (the whole
+        point of GroupAdaGrad — O(batch-rows) embedding steps)."""
+        rows = grad.indices._data
+        g = grad.data._data * self.rescale_grad
+        h = state["history"]._data
+        h_rows = h[rows] + jnp.mean(
+            g * g, axis=tuple(range(1, g.ndim)), keepdims=True)
+        h = h.at[rows].set(h_rows)
+        w = weight._data
+        upd = lr * g / jnp.sqrt(h_rows + self._eps)
+        weight._set_data(w.at[rows].add(-upd))
         state["history"]._set_data(h)

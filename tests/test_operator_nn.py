@@ -177,3 +177,70 @@ def test_ctc_loss_gradient_flows():
         loss = npx.ctc_loss(pred, label).sum()
     loss.backward()
     assert float(abs(pred.grad).sum()) > 0
+
+
+def test_new_nn_layers():
+    """SiLU / BatchNormReLU / ReflectionPad2D / PixelShuffle / Deformable
+    conv layers (reference: gluon/nn additions)."""
+    from mxnet_tpu.gluon import nn
+
+    x = np.array(onp.random.RandomState(0).randn(2, 4, 6, 6)
+                 .astype("float32"))
+
+    silu = nn.SiLU()
+    got = silu(x).asnumpy()
+    xa = x.asnumpy()
+    assert_almost_equal(got, xa / (1 + onp.exp(-xa)), rtol=1e-5, atol=1e-6)
+
+    bnr = nn.BatchNormReLU(in_channels=4)
+    bnr.initialize()
+    assert float(bnr(x).asnumpy().min()) >= 0.0
+
+    pad = nn.ReflectionPad2D(1)
+    out = pad(x).asnumpy()
+    assert out.shape == (2, 4, 8, 8)
+    assert_almost_equal(out[:, :, 0, 1:-1], xa[:, :, 1], rtol=1e-6)
+    assert_almost_equal(out[:, :, 1:-1, 0], xa[:, :, :, 1], rtol=1e-6)
+
+    ps = nn.PixelShuffle2D(2)
+    y = np.array(onp.arange(2 * 8 * 3 * 3, dtype="float32")
+                 .reshape(2, 8, 3, 3))
+    out = ps(y).asnumpy()
+    assert out.shape == (2, 2, 6, 6)
+    # torch-style semantics: out[b, c, h*f+i, w*f+j] = in[b, c*f*f+i*f+j, h, w]
+    assert out[0, 0, 0, 1] == y.asnumpy()[0, 1, 0, 0]
+    assert out[0, 0, 1, 0] == y.asnumpy()[0, 2, 0, 0]
+    ps1 = nn.PixelShuffle1D(3)
+    out1 = ps1(np.array(onp.zeros((1, 6, 4), "float32"))).asnumpy()
+    assert out1.shape == (1, 2, 12)
+
+    dc = nn.DeformableConvolution(8, kernel_size=(3, 3), padding=(1, 1),
+                                  in_channels=4)
+    dc.initialize()
+    out = dc(x)
+    assert out.shape == (2, 8, 6, 6)
+    # zero-initialized offsets -> equals a plain conv with same weights
+    from mxnet_tpu.ops import apply_op
+
+    conv = apply_op("convolution", x, dc.weight.data(), dc.bias.data(),
+                    kernel=(3, 3), pad=(1, 1), num_filter=8, no_bias=False)
+    assert_almost_equal(out.asnumpy(), conv.asnumpy(), rtol=1e-4,
+                        atol=1e-5)
+
+    mdc = nn.ModulatedDeformableConvolution(4, kernel_size=(3, 3),
+                                            padding=(1, 1), in_channels=4)
+    mdc.initialize()
+    out = mdc(x)
+    assert out.shape == (2, 4, 6, 6)
+    # training drives gradients into the offset conv
+    from mxnet_tpu import autograd, gluon
+
+    tr = gluon.Trainer(dc.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    before = dc.offset.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (dc(x) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+    after = dc.offset.weight.data().asnumpy()
+    assert not (before == after).all()

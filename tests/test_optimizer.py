@@ -244,7 +244,7 @@ def test_group_adagrad():
     w_before = w.asnumpy().copy()
     opt.update(0, w, g, state)
     hist = (g.asnumpy() ** 2).mean(axis=1, keepdims=True)
-    want = w_before - 0.1 * g.asnumpy() / (onp.sqrt(hist) + 1e-5)
+    want = w_before - 0.1 * g.asnumpy() / onp.sqrt(hist + 1e-5)
     assert_almost_equal(w.asnumpy(), want, rtol=1e-5, atol=1e-6)
     # a Trainer drives it end to end
     from mxnet_tpu import autograd, gluon
@@ -259,3 +259,21 @@ def test_group_adagrad():
         loss = (net(x) ** 2).sum()
     loss.backward()
     tr.step(4)
+
+    # lazy row-sparse path: only touched embedding rows move
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    opt2 = optimizer.create("groupadagrad", learning_rate=0.1)
+    w2 = np.array(onp.ones((6, 3), "float32"))
+    st2 = opt2.create_state(0, w2)
+    gdata = onp.ones((2, 3), "float32")
+    rs = RowSparseNDArray(NDArray(gdata), NDArray(onp.array([1, 4],
+                                                            "int32")),
+                          (6, 3))
+    opt2.update(0, w2, rs, st2)
+    w2n = w2.asnumpy()
+    assert (w2n[0] == 1).all() and (w2n[2] == 1).all()
+    assert (w2n[1] < 1).all() and (w2n[4] < 1).all()
+    assert float(st2["history"].asnumpy()[1]) > 0
+    assert float(st2["history"].asnumpy()[0]) == 0
