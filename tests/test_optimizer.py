@@ -275,5 +275,5 @@ def test_group_adagrad():
     w2n = w2.asnumpy()
     assert (w2n[0] == 1).all() and (w2n[2] == 1).all()
     assert (w2n[1] < 1).all() and (w2n[4] < 1).all()
-    assert float(st2["history"].asnumpy()[1]) > 0
-    assert float(st2["history"].asnumpy()[0]) == 0
+    assert float(st2["history"].asnumpy()[1, 0]) > 0
+    assert float(st2["history"].asnumpy()[0, 0]) == 0
