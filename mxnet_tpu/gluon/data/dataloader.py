@@ -278,8 +278,8 @@ class DataLoader:
 
         Concurrent iterators over one loader share the result queue, so
         each result is routed by its (epoch, batch) key: live epochs'
-        batches are stashed for their iterator (``self._stray``); only
-        epochs marked dead (``self._dead_epochs``) are unlinked.
+        batches are stashed for their iterator (``self._stray``); results
+        for epochs no longer in ``self._live_epochs`` are unlinked.
         """
         task_q, result_q, procs = self._ensure_pool()
         epoch = self._epoch

@@ -239,7 +239,15 @@ def multihead_attention(query, key, value, mask=None, num_heads=1,
                         num_kv_heads=None):
     """``num_kv_heads`` enables grouped-query / multi-query attention:
     key/value carry that many heads, each shared by a group of query
-    heads (TPU-native extension beyond the reference)."""
+    heads (TPU-native extension beyond the reference).
+
+    Masking note: a (B, 1, 1, Tk) key-padding mask rides the fused flash
+    path via segment ids. For the degenerate case of a fully-masked query
+    row the fused path emits zeros, whereas the dense where-mask branch
+    (any other mask shape) yields a ~uniform softmax over -inf logits.
+    Rows with at least one valid key are identical on both paths. The
+    same applies to graphs rewritten by ``optimize_for("tpu")``'s
+    attention-fusion pass."""
     args = [_nd(query), _nd(key), _nd(value)]
     if mask is not None:
         args.append(_nd(mask))

@@ -572,9 +572,10 @@ def _multihead_attention(num_heads=1, dropout=0.0, causal=False, scale=None,
                 mask[0].shape[3] == Tk and Tq == Tk:
             # key-padding mask (B, 1, 1, Tk), constant over heads and
             # queries: express as segment ids (valid=its mask value,
-            # padding=0) and stay on the fused flash path. Semantics match
-            # the dense-mask branch exactly: every query row attends the
-            # same valid-key set
+            # padding=0) and stay on the fused flash path. Matches the
+            # dense-mask branch for every row with >=1 valid key; a fully
+            # masked row emits zeros here vs ~uniform softmax there
+            # (documented in npx.multihead_attention)
             from .pallas_kernels import flash_attention
 
             seg = (mask[0].reshape(B, Tk) != 0).astype(jnp.int32)

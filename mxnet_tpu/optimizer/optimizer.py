@@ -764,18 +764,26 @@ _registry.alias("adamw", "adamw")
 class GroupAdaGrad(Optimizer):
     """Row-grouped AdaGrad (reference: optimizer/contrib.py GroupAdaGrad):
     one accumulated history scalar per row (embedding-style grouping),
-    update = lr * grad / sqrt(history + eps)."""
+    update = lr * grad / (sqrt(history) + eps). Weight decay is unsupported,
+    matching the reference's documented restriction."""
 
-    def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
+    @staticmethod
+    def _reject_wd(wd):
+        if wd:
+            raise ValueError("GroupAdaGrad does not support weight decay "
+                             "(reference optimizer/contrib.py restriction)")
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-6, **kwargs):
+        self._reject_wd(kwargs.get("wd"))
         super().__init__(learning_rate, **kwargs)
         self._eps = epsilon
 
         def step(w, h, g, lr, wd):
-            g = self._pre(g) + wd * w
+            g = self._pre(g)
             # mean over the non-row axes; axis=() is the identity for 1-D
             h = h + jnp.mean(g * g, axis=tuple(range(1, g.ndim)),
                              keepdims=True)
-            return w - lr * g / jnp.sqrt(h + epsilon), h
+            return w - lr * g / (jnp.sqrt(h) + epsilon), h
 
         self._step = _jit_step(step, 2)
 
@@ -785,6 +793,7 @@ class GroupAdaGrad(Optimizer):
         return {"history": NDArray(jnp.zeros(shape, jnp.float32))}
 
     def _apply(self, w, g, state, lr, wd, t):
+        self._reject_wd(float(wd))
         new_w, h = self._step(w._data, state["history"]._data, g._data,
                               lr, wd)
         w._set_data(new_w)
@@ -792,14 +801,17 @@ class GroupAdaGrad(Optimizer):
 
     def _apply_sparse(self, weight, grad, state, lr, wd, t):
         """Lazy row-sparse path: only the touched rows update (the whole
-        point of GroupAdaGrad — O(batch-rows) embedding steps)."""
+        point of GroupAdaGrad — O(batch-rows) embedding steps). Same
+        pre-processing as the dense path: rescale then clip, no wd."""
+        self._reject_wd(float(wd))
         rows = grad.indices._data
-        g = grad.data._data * self.rescale_grad
+        g = self._pre(grad.data._data * self.rescale_grad)
         h = state["history"]._data
         h_rows = h[rows] + jnp.mean(
             g * g, axis=tuple(range(1, g.ndim)), keepdims=True)
         h = h.at[rows].set(h_rows)
         w = weight._data
-        upd = lr * g / jnp.sqrt(h_rows + self._eps)
+        upd = lr * g / (jnp.sqrt(h_rows) + self._eps)
         weight._set_data(w.at[rows].add(-upd))
         state["history"]._set_data(h)
+        return True  # handled: _update_one must not densify and re-apply

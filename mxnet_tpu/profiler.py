@@ -213,7 +213,10 @@ def scope(name="<unk>"):
         for bid in [b for b in _scope_by_id if b not in alive]:
             del _scope_by_id[bid]
         for a in live_now:
-            if id(a) in before:
+            if id(a) in before or id(a) in _scope_by_id:
+                # already attributed: an inner scope's exit runs first, so
+                # skipping claimed ids keeps attribution innermost and stops
+                # enclosing scopes double-counting the same buffer
                 continue
             _scope_by_id[id(a)] = name
             key = (name, tuple(a.shape), str(a.dtype))

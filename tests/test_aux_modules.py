@@ -420,6 +420,29 @@ def test_memory_profiler_per_alloc(tmp_path):
         profiler.dumps(reset=True)
 
 
+def test_memory_profiler_nested_scope_single_attribution():
+    """A buffer allocated inside an inner scope is attributed once, to the
+    innermost scope — enclosing scopes must not re-count it on exit."""
+    from mxnet_tpu import np, profiler
+
+    profiler.set_config(profile_memory=True)
+    try:
+        with profiler.scope("outer"):
+            with profiler.scope("inner"):
+                kept = np.array(onp.random.RandomState(0)
+                                .randn(7, 13).astype("float32"))
+                float(kept.asnumpy()[0, 0])  # materialize before scope exit
+        recs = {r[0]: (r[3], r[4]) for r in profiler.memory_records()
+                if r[1] == (7, 13)}
+        assert "inner" in recs, recs
+        assert "outer" not in recs, \
+            f"enclosing scope double-counted the buffer: {recs}"
+        del kept
+    finally:
+        profiler.set_config(profile_memory=False)
+        profiler.dumps(reset=True)
+
+
 def test_amp_lists_audited_and_fp8():
     """AMP op lists (reference: amp/lists/symbol_fp16.py) name only
     registered ops; MXU ops cast under every supported AMP dtype incl.

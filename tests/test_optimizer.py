@@ -244,8 +244,11 @@ def test_group_adagrad():
     w_before = w.asnumpy().copy()
     opt.update(0, w, g, state)
     hist = (g.asnumpy() ** 2).mean(axis=1, keepdims=True)
-    want = w_before - 0.1 * g.asnumpy() / onp.sqrt(hist + 1e-5)
+    want = w_before - 0.1 * g.asnumpy() / (onp.sqrt(hist) + 1e-6)
     assert_almost_equal(w.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    # weight decay is rejected, matching the reference restriction
+    with pytest.raises(ValueError):
+        optimizer.create("groupadagrad", learning_rate=0.1, wd=0.01)
     # a Trainer drives it end to end
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu.gluon import nn
@@ -275,5 +278,11 @@ def test_group_adagrad():
     w2n = w2.asnumpy()
     assert (w2n[0] == 1).all() and (w2n[2] == 1).all()
     assert (w2n[1] < 1).all() and (w2n[4] < 1).all()
-    assert float(st2["history"].asnumpy()[1, 0]) > 0
-    assert float(st2["history"].asnumpy()[0, 0]) == 0
+    hist2 = st2["history"].asnumpy()
+    assert float(hist2[0, 0]) == 0
+    # exact-value check: the sparse step must apply exactly once (a falsy
+    # _apply_sparse would densify and re-apply, doubling touched rows)
+    h_want = (gdata ** 2).mean(axis=1, keepdims=True)
+    w_want = 1.0 - 0.1 * gdata / (onp.sqrt(h_want) + 1e-6)
+    assert_almost_equal(w2n[[1, 4]], w_want, rtol=1e-6, atol=1e-7)
+    assert_almost_equal(hist2[[1, 4]], h_want, rtol=1e-6, atol=1e-7)
