@@ -53,6 +53,101 @@ def run(loader, batches):
     return n / (time.time() - t0)
 
 
+# ---------------------------------------------------------------------------
+# transport-level throughput: bytes/s through the worker->parent channel,
+# decode cost excluded. Meaningful on ONE core — it measures copy/IPC
+# bandwidth, not parallel speedup: shm moves a batch with two memcpys while
+# a pickled queue serializes it through a 64 KiB pipe.
+# ---------------------------------------------------------------------------
+_T_SHAPE = (4 * 1024 * 1024,)  # 16 MiB float32 per batch
+_T_ITERS = 12
+_T_NBYTES = 16 * 1024 * 1024
+
+
+def _pin_cpu_child():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — jax optional here
+        pass
+
+
+def _shm_sender(q):
+    _pin_cpu_child()
+    from mxnet_tpu.gluon.data.dataloader import _to_shm
+
+    arr = onp.ones(_T_SHAPE, "float32")
+    for _ in range(_T_ITERS):
+        segments = []
+        q.put(_to_shm(arr, segments))
+        for s in segments:
+            s.close()
+
+
+def _pickle_sender(q):
+    _pin_cpu_child()
+    arr = onp.ones(_T_SHAPE, "float32")
+    for _ in range(_T_ITERS):
+        q.put(arr)
+
+
+def _recv_shm(q):
+    # symmetric endpoint work: both receivers end with an OWNED host array
+    # (unpickling already materializes one on the queue path, so the shm
+    # path maps the segment and pays exactly one memcpy — device placement
+    # is deliberately excluded from both sides: it is not transport)
+    from multiprocessing import shared_memory
+
+    _tag, name, shape, dtype = q.get(timeout=120)
+    shm = shared_memory.SharedMemory(name=name)
+    onp.array(onp.ndarray(shape, onp.dtype(dtype), buffer=shm.buf))
+    shm.close()
+    shm.unlink()
+
+
+def _recv_pickle(q):
+    q.get(timeout=120)  # unpickle materializes the owned host array
+
+
+def _transport_bps(sender, recv):
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue(maxsize=2)
+    p = ctx.Process(target=sender, args=(q,), daemon=True)
+    # children inherit the env at exec time: pin them to CPU BEFORE they
+    # re-import this module (same hazard DataLoader._ensure_pool guards —
+    # an unpinned child would race the parent for the TPU runtime)
+    prev = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        p.start()
+    finally:
+        if prev is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev
+    recv(q)  # first batch excluded: absorbs spawn + import warmup
+    t0 = time.perf_counter()
+    for _ in range(_T_ITERS - 1):
+        recv(q)
+    dt = time.perf_counter() - t0
+    p.join(timeout=10)
+    return (_T_ITERS - 1) * _T_NBYTES / dt
+
+
+def bench_transport():
+    """Returns {shm_bytes_per_sec, pickle_queue_bytes_per_sec, ratio}."""
+    shm = _transport_bps(_shm_sender, _recv_shm)
+    pkl = _transport_bps(_pickle_sender, _recv_pickle)
+    return {"shm_MBps": round(shm / 1e6, 1),
+            "pickle_queue_MBps": round(pkl / 1e6, 1),
+            "shm_over_pickle": round(shm / pkl, 2),
+            "batch_MiB": _T_NBYTES // (1024 * 1024)}
+
+
 def main():
     n = 512
     ds = gluon.data.SimpleDataset(
@@ -70,6 +165,7 @@ def main():
     results["unit"] = "samples/sec"
     results["process_vs_thread"] = results["processes_4"] / \
         results["threads_4"]
+    results["transport"] = bench_transport()
     results["cores"] = len(os.sched_getaffinity(0)) \
         if hasattr(os, "sched_getaffinity") else os.cpu_count()
     if results["cores"] == 1:

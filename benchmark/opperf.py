@@ -77,6 +77,50 @@ def bench_op(name, args, attrs, warmup=3, iters=20):
     return (time.perf_counter() - t0) / iters
 
 
+def bench_dispatch_overhead(n_calls=2000, chain_len=64):
+    """Bare per-op python-dispatch cost vs the amortized per-op cost inside
+    one hybridized program — the apples comparison the reference's
+    packed-func FFI was built around (benchmark/python/ffi/benchmark_ffi.py
+    times 2x2-sized calls exactly like this; SURVEY N14: the FFI rework
+    bought ~10x over ctypes because per-call overhead dominates tiny ops).
+
+    Here "dispatch" = registry lookup + per-(op,attrs) jit-cache hit +
+    PJRT enqueue; compute on a 2x2 input is negligible, so the µs/call IS
+    the overhead. The hybrid column divides one jitted chain of
+    ``chain_len`` adds by its length: what CachedOp amortizes away."""
+    from mxnet_tpu import np
+    from mxnet_tpu.cached_op import trace
+
+    a = np.ones((2, 2))
+    b = np.ones((2, 2))
+    out = a + b
+    sync(out)  # warm the jit cache for this (op, shape, dtype)
+    t0 = time.perf_counter()
+    for _ in range(n_calls):
+        out = a + b
+    sync(out)
+    eager_us = (time.perf_counter() - t0) / n_calls * 1e6
+
+    def chain(x):
+        for _ in range(chain_len):
+            x = x + b
+        return x
+
+    _, _, cop = trace(chain, [a], [("b", b)])
+    sync(cop(a, b))
+    t1 = time.perf_counter()
+    reps = max(1, n_calls // chain_len)
+    for _ in range(reps):
+        out = cop(a, b)
+    sync(out)
+    hybrid_us = (time.perf_counter() - t1) / reps / chain_len * 1e6
+    return {"eager_dispatch_us_per_op": round(eager_us, 2),
+            "hybridized_us_per_op": round(hybrid_us, 2),
+            "eager_over_hybrid": round(eager_us / hybrid_us, 1),
+            "workload": "2x2 add, warm jit cache",
+            "n_calls": n_calls, "chain_len": chain_len}
+
+
 def bench_eager_vs_hybrid(n, warmup=3, iters=20):
     """The dispatch-cost story (reference built a packed-func FFI because
     this number matters: benchmark/python/ffi/): one forward of a small
@@ -140,19 +184,23 @@ def main():
                         "size": args.size})
     compare = bench_eager_vs_hybrid(min(args.size, 512))
     compare["backend"] = default_backend()
+    dispatch = bench_dispatch_overhead()
+    dispatch["backend"] = default_backend()
     if args.table:
         print(f"{'op':<20}{'avg ms':>12}")
         for r in results:
             print(f"{r['op']:<20}{r['avg_time_ms']:>12.4f}")
         print(json.dumps(compare))
+        print(json.dumps(dispatch))
     else:
         for r in results:
             print(json.dumps(r))
         print(json.dumps(compare))
+        print(json.dumps(dispatch))
     if args.out:
         with open(args.out, "w") as fh:
-            json.dump({"per_op": results, "eager_vs_hybrid": compare},
-                      fh, indent=1)
+            json.dump({"per_op": results, "eager_vs_hybrid": compare,
+                       "dispatch_overhead": dispatch}, fh, indent=1)
 
 
 if __name__ == "__main__":

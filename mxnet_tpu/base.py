@@ -21,6 +21,31 @@ class MXNetError(RuntimeError):
     """Framework error type (reference: MXGetLastError / dmlc::Error)."""
 
 
+# Single-chip element bound: XLA:TPU addresses buffers with 32-bit offsets,
+# so one unsharded array may hold at most INT32_MAX elements. The reference
+# gates the same boundary behind its INT64_TENSOR_SIZE build flag
+# (src/libinfo.cc:39-161, tests/nightly/test_large_array.py); here larger
+# arrays are served by sharding over a mesh axis instead, and crossing the
+# bound on one chip raises a typed error rather than whatever XLA does.
+INT32_ELEM_BOUND = 2 ** 31 - 1
+
+
+def check_int32_bound(shape, what="array"):
+    """Raise MXNetError if ``shape`` holds more than INT32_ELEM_BOUND
+    elements (called before allocation on the shape-taking creation ops)."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    if n > INT32_ELEM_BOUND:
+        raise MXNetError(
+            f"{what} of shape {tuple(shape)} has {n:,} elements, over the "
+            f"single-chip int32 index bound ({INT32_ELEM_BOUND:,}). Shard "
+            "it over a device mesh axis (jax.sharding / Learner "
+            "param_spec_fn) or reduce the shape; the reference's analog is "
+            "the INT64_TENSOR_SIZE large-tensor build (src/libinfo.cc:39).")
+    return shape
+
+
 # ---------------------------------------------------------------------------
 # dtypes
 # ---------------------------------------------------------------------------

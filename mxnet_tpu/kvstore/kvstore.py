@@ -491,6 +491,7 @@ def create(name="local") -> KVStoreBase:
                "dist_sync": "dist_sync", "dist_device_sync":
                "dist_device_sync", "dist": "dist_sync",
                "horovod": "horovod", "byteps": "byteps"}
-    if name not in aliases:
-        raise MXNetError(f"unknown kvstore type {name!r}")
-    return KVStoreBase.get_kvstore_class(aliases[name])()
+    # names outside the built-in alias table fall through to the registry,
+    # so user backends registered via KVStoreBase.register are creatable
+    # by name exactly like the built-ins (reference: kvstore/base.py:220)
+    return KVStoreBase.get_kvstore_class(aliases.get(name, name))()

@@ -123,6 +123,10 @@ class NDArray:
         return self
 
     def wait_to_write(self):
+        # same barrier as wait_to_read by design: "writes" rebind the handle
+        # to a fresh immutable buffer, so there is no write queue to drain
+        # (docs/DESIGN.md "In-place semantics"); the reference needed the
+        # distinction only because its engine mutated buffers in place
         return self.wait_to_read()
 
     def asnumpy(self) -> onp.ndarray:
@@ -314,6 +318,11 @@ class NDArray:
     # ------------------------------------------------------------- autograd
     def attach_grad(self, grad_req="write", stype=None):
         """Allocate a grad buffer and mark self as a gradient sink.
+
+        ``stype`` is accepted for API parity but ignored: gradients are
+        always dense here (reference row_sparse grads exist to skip zero
+        rows on CPU; under XLA the dense grad is a fused kernel and the
+        sparse optimizer paths take RowSparseNDArray grads explicitly).
 
         Reference: python/mxnet/numpy/multiarray.py attach_grad ->
         Imperative::MarkVariables.

@@ -17,6 +17,7 @@ from __future__ import annotations
 import numpy as _onp
 
 from ..base import canonical_dtype as _canon
+from ..base import check_int32_bound as _check_bound
 from ..context import current_context
 from ..ndarray.ndarray import NDArray, array
 from ..ops.registry import apply_op as _op
@@ -224,7 +225,9 @@ def expand_dims(a, axis):
 
 
 def broadcast_to(a, shape):
-    return _op("broadcast_to", _as_nd(a), shape=tuple(shape))
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    _check_bound(shape, "broadcast_to")
+    return _op("broadcast_to", _as_nd(a), shape=shape)
 
 
 def broadcast_arrays(*args):
@@ -474,6 +477,11 @@ array = array
 
 
 def _place(data, ctx=None, device=None):
+    # backstop for the int32 single-chip bound: the shape-taking creation
+    # ops check BEFORE allocating; anything that slipped through (new
+    # creation ops, computed shapes) still surfaces a typed MXNetError
+    # here instead of undefined 32-bit-offset behavior downstream
+    _check_bound(data.shape)
     arr = NDArray(data)
     tgt = device or ctx
     if tgt is not None and tgt != arr.ctx:
@@ -484,21 +492,24 @@ def _place(data, ctx=None, device=None):
 def zeros(shape, dtype="float32", order="C", ctx=None, device=None):
     import jax.numpy as jnp
 
-    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    shape = _check_bound((shape,) if isinstance(shape, int)
+                         else tuple(shape))
     return _place(jnp.zeros(shape, _canon(dtype) or _onp.float32), ctx, device)
 
 
 def ones(shape, dtype="float32", order="C", ctx=None, device=None):
     import jax.numpy as jnp
 
-    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    shape = _check_bound((shape,) if isinstance(shape, int)
+                         else tuple(shape))
     return _place(jnp.ones(shape, _canon(dtype) or _onp.float32), ctx, device)
 
 
 def full(shape, fill_value, dtype=None, ctx=None, device=None, out=None):
     import jax.numpy as jnp
 
-    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    shape = _check_bound((shape,) if isinstance(shape, int)
+                         else tuple(shape))
     if isinstance(fill_value, NDArray):
         fill_value = fill_value._data
     data = jnp.full(shape, fill_value,
@@ -541,6 +552,10 @@ def empty_like(a, dtype=None, ctx=None):
 def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
     import jax.numpy as jnp
 
+    lo, hi = (0, start) if stop is None else (start, stop)
+    if step:
+        n = int(-(-(hi - lo) // step))  # ceil; module shadows builtin max
+        _check_bound((n if n > 0 else 0,), "arange")
     return _place(jnp.arange(start, stop, step,
                              _canon(dtype) if dtype else None), ctx, device)
 
@@ -549,6 +564,7 @@ def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
              axis=0, ctx=None, device=None):
     import jax.numpy as jnp
 
+    _check_bound((int(num),), "linspace")
     out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
                        dtype=_canon(dtype) if dtype else None, axis=axis)
     if retstep:
@@ -560,6 +576,7 @@ def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
              ctx=None):
     import jax.numpy as jnp
 
+    _check_bound((int(num),), "logspace")
     return _place(jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
                                dtype=_canon(dtype) if dtype else None), ctx)
 
@@ -567,6 +584,7 @@ def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
 def eye(N, M=None, k=0, dtype="float32", ctx=None, device=None):
     import jax.numpy as jnp
 
+    _check_bound((int(N), int(M if M is not None else N)), "eye")
     return _place(jnp.eye(N, M, k, _canon(dtype)), ctx, device)
 
 
@@ -577,13 +595,16 @@ def identity(n, dtype="float32", ctx=None):
 def tri(N, M=None, k=0, dtype="float32", ctx=None):
     import jax.numpy as jnp
 
+    _check_bound((int(N), int(M if M is not None else N)), "tri")
     return _place(jnp.tri(N, M, k, _canon(dtype)), ctx)
 
 
 def indices(dimensions, dtype="int32", ctx=None):
     import jax.numpy as jnp
 
-    return _place(jnp.indices(tuple(dimensions), dtype=_canon(dtype)), ctx)
+    dims = tuple(dimensions)
+    _check_bound((len(dims),) + dims, "indices")
+    return _place(jnp.indices(dims, dtype=_canon(dtype)), ctx)
 
 
 def asarray(a, dtype=None):

@@ -296,3 +296,46 @@ def test_error_module_registry():
         pass
 
     assert isinstance(error._normalize("CustomKind: x"), CustomKind)
+
+
+def test_war_ordering_stress():
+    """Write-after-read safety under async dispatch (reference engine vars:
+    src/engine/threaded_engine.h:136-165): an op dispatched on X must see
+    X's value at call time even if Python immediately mutates X in place.
+    Here in-place mutation rebinds the handle to a fresh immutable buffer,
+    so the consumer's captured buffer can never change under it — this
+    test stresses the window between async dispatch and mutation."""
+    rs = onp.random.RandomState(7)
+    x = np.array(rs.randn(192, 192).astype("float32") * 0.1)
+    for i in range(100):
+        snapshot = x.asnumpy()  # value the consumer must observe
+        y = np.dot(x, x)        # async dispatch; do NOT sync
+        # immediate in-place overwrite while the matmul may be in flight
+        x[:] = np.array(rs.randn(192, 192).astype("float32") * 0.1)
+        got = y.asnumpy()
+        assert_almost_equal(got, snapshot @ snapshot, rtol=1e-4, atol=1e-4)
+    # augmented assignment is the same rebind path
+    a = np.array(onp.arange(8, dtype="float32"))
+    b = a * 2.0  # async consumer of a's buffer
+    a += 100.0
+    assert b.asnumpy().tolist() == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_large_index_guardrail():
+    """Arrays beyond the single-chip int32 element bound raise a typed
+    MXNetError before allocation (reference: INT64_TENSOR_SIZE build flag,
+    src/libinfo.cc:39-161 + tests/nightly/test_large_array.py). Memory-
+    light: the guard fires on the shape, nothing is allocated."""
+    big = 2 ** 31  # one past the bound
+    for maker in (lambda: np.zeros((big,), dtype="int8"),
+                  lambda: np.ones((2 ** 16, 2 ** 16), dtype="int8"),
+                  lambda: np.full((big,), 3, dtype="int8"),
+                  lambda: np.arange(big, dtype="int8"),
+                  lambda: np.eye(2 ** 16, 2 ** 16),
+                  lambda: np.linspace(0.0, 1.0, big),
+                  lambda: np.broadcast_to(np.zeros((1,)), (big,))):
+        with pytest.raises(MXNetError, match="int32 index bound"):
+            maker()
+    # at the bound itself nothing raises (shape check only, no alloc here)
+    from mxnet_tpu.base import check_int32_bound
+    check_int32_bound((2 ** 31 - 1,))
