@@ -65,13 +65,9 @@ _T_NBYTES = 16 * 1024 * 1024
 
 
 def _pin_cpu_child():
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        import jax
+    from mxnet_tpu.context import pin_process_to_cpu
 
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:  # noqa: BLE001 — jax optional here
-        pass
+    pin_process_to_cpu()
 
 
 def _shm_sender(q):
@@ -120,15 +116,10 @@ def _transport_bps(sender, recv):
     # children inherit the env at exec time: pin them to CPU BEFORE they
     # re-import this module (same hazard DataLoader._ensure_pool guards —
     # an unpinned child would race the parent for the TPU runtime)
-    prev = os.environ.get("JAX_PLATFORMS")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
+    from mxnet_tpu.context import spawn_cpu_pinned_env
+
+    with spawn_cpu_pinned_env():
         p.start()
-    finally:
-        if prev is None:
-            os.environ.pop("JAX_PLATFORMS", None)
-        else:
-            os.environ["JAX_PLATFORMS"] = prev
     recv(q)  # first batch excluded: absorbs spawn + import warmup
     t0 = time.perf_counter()
     for _ in range(_T_ITERS - 1):

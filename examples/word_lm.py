@@ -52,6 +52,10 @@ def main():
     ap.add_argument("--hidden", type=int, default=200)
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--export-onnx", default=None, metavar="PATH",
+                    help="after training, export the LM to this .onnx file "
+                         "(fused LSTM -> ONNX LSTM nodes) and verify the "
+                         "re-import numerically")
     args = ap.parse_args()
 
     corpus, vocab = load_corpus(args.data)
@@ -83,6 +87,21 @@ def main():
         ppl = onp.exp(total / count)
         print(f"Epoch {epoch}: loss {total / count:.3f} ppl {ppl:.2f} "
               f"({time.time() - tic:.1f}s)")
+
+    if args.export_onnx:
+        from mxnet_tpu.contrib import onnx as mxonnx
+
+        # stateless forward (states=None) is the inference entry point
+        path = mxonnx.export_model(model, input_shape=(1, args.bptt),
+                                   input_type="int32",
+                                   onnx_file_path=args.export_onnx)
+        blk = mxonnx.import_to_gluon(path)
+        probe = np.array(onp.array(stream[:1, :args.bptt], "int32"))
+        with autograd.predict_mode():
+            want = model(probe).asnumpy()
+        got = blk(probe).asnumpy()
+        err = float(onp.abs(got - want).max())
+        print(f"ONNX export -> {path}; re-import max |diff| = {err:.2e}")
 
 
 if __name__ == "__main__":

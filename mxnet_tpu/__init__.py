@@ -79,6 +79,22 @@ kv = kvstore
 # their reference-name aliases now that every subpackage has imported
 ops.aliases._register_all()
 
+# Resolve the backend through the hardened subprocess probe at import: the
+# first in-process jax touch (a bare jnp call inside any creation op)
+# otherwise dials the accelerator runtime directly, and a dead tunneled-TPU
+# plugin blocks ~25 min inside make_c_api_client with no recourse (round-4
+# diagnosis; context.default_backend documents the probe contract). With a
+# healthy or pinned-cpu runtime this is cheap; with a dead accelerator it
+# converts an unbounded hang into a bounded, loudly-warned CPU fallback.
+# Opt out with MXTPU_DEFER_BACKEND_PROBE=1 (symbol-only tooling). Skipped
+# automatically under a distributed launch (MXTPU_DIST_NPROC /
+# JAX coordinator env): workers must leave the backend uninitialized until
+# kvstore.create('dist_sync') joins the process group.
+if not __import__("os").environ.get("MXTPU_DEFER_BACKEND_PROBE") and \
+        not __import__("os").environ.get("MXTPU_DIST_NPROC") and \
+        not __import__("os").environ.get("JAX_COORDINATOR_ADDRESS"):
+    context.ensure_backend()
+
 
 def waitall():
     engine.wait_all()

@@ -284,6 +284,18 @@ def default_backend() -> str:
     import jax
     from jax._src import xla_bridge as _xb
 
+    if os.environ.get("MXTPU_FORCE_CPU") == "1":
+        # out-of-band CPU pin that survives site hooks rewriting
+        # JAX_PLATFORMS/jax.config in every child interpreter: the test
+        # conftest, DataLoader worker spawner and launchers set this so
+        # spawned processes skip probing entirely
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — backends may already be live
+            pass
+        _probe_cache["backend"] = "cpu"
+        return "cpu"
+
     forced = getattr(jax.config, "jax_platforms", None) or \
         os.environ.get("JAX_PLATFORMS") or ""
     # direct call is safe only when backends are already live or the forced
@@ -356,6 +368,61 @@ def default_backend() -> str:
         _write_probe_marker()
     _probe_cache["backend"] = b
     return b
+
+
+def spawn_cpu_pinned_env():
+    """Context manager setting ``JAX_PLATFORMS=cpu`` + ``MXTPU_FORCE_CPU=1``
+    around ``Process.start()`` so spawned children pin to CPU at import —
+    the second var survives site hooks that rewrite JAX env/config in every
+    child interpreter (the consumer is :func:`default_backend`). One
+    definition next to that consumer; DataLoader and the benches use it."""
+    import contextlib
+    import os
+
+    @contextlib.contextmanager
+    def _cm():
+        saved = {k: os.environ.get(k)
+                 for k in ("JAX_PLATFORMS", "MXTPU_FORCE_CPU")}
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["MXTPU_FORCE_CPU"] = "1"
+        try:
+            yield
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    return _cm()
+
+
+def pin_process_to_cpu() -> None:
+    """Child-side belt-and-braces: pin THIS process to the CPU backend
+    before any jax work (spawned workers call this first thing)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["MXTPU_FORCE_CPU"] = "1"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — jax optional in pure-numpy workers
+        pass
+
+
+def ensure_backend() -> None:
+    """Resolve the backend through the hardened probe BEFORE the first
+    in-process jax touch. A bare ``jnp.ones`` as a process's first device
+    call initializes the accelerator runtime directly — with a dead
+    tunneled-TPU plugin that blocks ~25 min inside ``make_c_api_client``
+    (round-4 diagnosis) and bypasses every safeguard in
+    :func:`default_backend`. The NDArray constructor and the op
+    dispatcher call this once per process; after the first call it is a
+    dict hit."""
+    if _probe_cache["backend"] is None:
+        default_backend()
 
 
 def _is_tpu_platform(name: str) -> bool:

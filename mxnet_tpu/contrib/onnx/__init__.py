@@ -5,10 +5,21 @@ Implemented WITHOUT the onnx package: the wire format is written/read by an
 in-tree protobuf codec (_proto.py). Covered op set: Dense/Gemm, Conv,
 pooling (incl. global/ceil), BatchNorm (inference), activations (relu/
 sigmoid/tanh/leaky/elu/gelu-by-erf), softmax/log_softmax, LayerNorm,
-reshape/flatten/transpose/concat/squeeze/unsqueeze, Gather/embedding,
-elementwise arithmetic, dropout (exported as Identity). Ops outside the set
-raise MXNetError naming the op. If a real ``onnx`` package is present it is
-NOT required — files round-trip through this codec.
+reshape/flatten/transpose/swapaxes/concat/squeeze/unsqueeze,
+Gather/embedding, static basic indexing (slice_key -> Slice/Squeeze/
+Unsqueeze), fused LSTM stacks (one ONNX LSTM per layer, ifgo<->iofc gate
+reorder on the weight initializers), fused multihead_attention (decomposed
+to Reshape/Transpose/MatMul/Softmax with baked causal / additive key
+masks), multibox_prior (anchors baked as initializers — shape-only
+constants in inference graphs), elementwise arithmetic, dropout (exported
+as Identity). This closes the model zoo: every registered vision model,
+the word-LM LSTM and BERT round-trip numerically (tests/test_contrib.py
+representatives; tests/nightly/test_onnx_full_zoo.py sweeps all). Known
+gaps: GRU/vanilla-RNN export, bidirectional LSTM import, grouped-query
+attention, advanced (array) indexing. Ops outside the set raise MXNetError
+naming the op. If a real ``onnx`` package is present it is NOT required —
+files round-trip through this codec (and a skipped-unless-available test
+validates through the real checker/runtime when the package exists).
 """
 from __future__ import annotations
 
@@ -50,7 +61,8 @@ def export_model(sym, params=None, input_shape=None, input_type=None,
             shape = input_shape[0]  # list of shapes: first data input
         else:
             shape = input_shape  # a single shape (tuple or int list)
-        x = mx.np.zeros(tuple(shape))
+        dtype = input_type or "float32"
+        x = mx.np.zeros(tuple(shape), dtype=dtype)
         block = sym
         with mx.autograd.predict_mode():
             block(x)  # settle deferred init
@@ -60,20 +72,36 @@ def export_model(sym, params=None, input_shape=None, input_type=None,
             _, _, cop = trace(lambda a: block(a), [x], param_list)
         params_np = {n: arr.asnumpy() for n, arr in param_list}
         return export_symbol(cop.sym, params_np, {"data0": tuple(shape)},
-                             onnx_file_path)
+                             onnx_file_path,
+                             input_dtypes={"data0": dtype})
 
     params = params or {}
     params_np = {k: (v.asnumpy() if isinstance(v, NDArray)
                      else onp.asarray(v)) for k, v in params.items()}
     if isinstance(input_shape, dict):
         shapes = {k: tuple(v) for k, v in input_shape.items()}
+        ordered = list(shapes)
     else:
         free = [n for n in sym.list_arguments() if n not in params_np]
         if input_shape is None or len(free) != len(input_shape):
             raise MXNetError(
                 f"export_model: need shapes for inputs {free}")
         shapes = dict(zip(free, [tuple(s) for s in input_shape]))
-    return export_symbol(sym, params_np, shapes, onnx_file_path)
+        ordered = free
+    if isinstance(input_type, dict):
+        dtypes = {k: str(v) for k, v in input_type.items()}
+    elif isinstance(input_type, (list, tuple)):
+        if len(input_type) != len(ordered):
+            raise MXNetError(
+                f"export_model: {len(input_type)} input types for "
+                f"{len(ordered)} inputs {ordered}")
+        dtypes = dict(zip(ordered, [str(t) for t in input_type]))
+    elif input_type is not None:  # one dtype for every data input
+        dtypes = {k: str(input_type) for k in shapes}
+    else:
+        dtypes = None
+    return export_symbol(sym, params_np, shapes, onnx_file_path,
+                         input_dtypes=dtypes)
 
 
 def import_model(model_file):

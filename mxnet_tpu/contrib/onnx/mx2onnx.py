@@ -63,6 +63,7 @@ class _Exporter:
         self.nodes: list[bytes] = []
         self.initializers: list[bytes] = []
         self.counter = 0
+        self.shapes: dict[str, tuple] = {}   # output name -> static shape
 
     def fresh(self, hint="t"):
         self.counter += 1
@@ -70,6 +71,19 @@ class _Exporter:
 
     def add_initializer(self, name, arr):
         self.initializers.append(P.w_msg(5, _tensor_proto(name, arr)))
+
+    def shape_of(self, name):
+        shp = self.shapes.get(name)
+        if shp is None:
+            raise MXNetError(
+                f"ONNX export: converter needs the static shape of "
+                f"{name!r} but shape inference did not produce one")
+        return shp
+
+    def ints_const(self, values, hint="i"):
+        nm = self.fresh(hint)
+        self.add_initializer(nm, onp.asarray(list(values), "int64"))
+        return nm
 
     def convert(self, node, in_names, out_names):
         op = node.op.name
@@ -233,6 +247,242 @@ class _Exporter:
             _attr_f("epsilon", float(a.get("eps", 1e-5)))
         self.nodes.append(_node("LayerNormalization", ins, outs, attrs))
 
+    def cv_swapaxes(self, a, ins, outs):
+        ndim = len(self.shape_of(ins[0]))
+        ax1 = a.get("axis1", 0) % ndim
+        ax2 = a.get("axis2", 0) % ndim
+        perm = list(range(ndim))
+        perm[ax1], perm[ax2] = perm[ax2], perm[ax1]
+        self.nodes.append(_node("Transpose", ins, outs,
+                                _attr_ints("perm", perm)))
+
+    def cv_slice_key(self, a, ins, outs):
+        """Static basic indexing (ints/slices/ellipsis/None) as ONNX
+        Slice + Squeeze + Unsqueeze. Advanced (array) indices would arrive
+        as extra inputs — unsupported here."""
+        if len(ins) > 1:
+            raise MXNetError("ONNX export: advanced (array) indexing has "
+                             "no ONNX mapping; rewrite with take/gather")
+        spec = a.get("spec", ())
+        shape = self.shape_of(ins[0])
+        rank = len(shape)
+        n_real = sum(1 for s in spec if s[0] in ("s", "i"))
+        starts, ends, axes, steps = [], [], [], []
+        squeeze_axes, unsq_positions = [], []
+        axis = out_pos = 0
+        for s in spec:
+            if s[0] == "e":                      # Ellipsis
+                skip = rank - n_real
+                axis += skip
+                out_pos += skip
+            elif s[0] == "n":                    # None / newaxis
+                unsq_positions.append(out_pos)
+                out_pos += 1
+            elif s[0] == "i":                    # integer: slice + squeeze
+                i = s[1]
+                starts.append(i)
+                ends.append(i + 1 if i != -1 else 2 ** 31)
+                axes.append(axis)
+                steps.append(1)
+                squeeze_axes.append(axis)
+                axis += 1
+            else:                                # ("s", start, stop, step)
+                st, sp, stp = s[1], s[2], s[3] if s[3] is not None else 1
+                if not (st is None and sp is None and stp == 1):
+                    # None start means index 0 forward but LAST backward;
+                    # ONNX clamps out-of-range starts/ends per step sign
+                    starts.append((0 if stp > 0 else 2 ** 31)
+                                  if st is None else st)
+                    ends.append((2 ** 31 if stp > 0 else -2 ** 31)
+                                if sp is None else sp)
+                    axes.append(axis)
+                    steps.append(stp)
+                axis += 1
+                out_pos += 1
+        stages = []
+        if starts:
+            stages.append(("Slice", lambda x: [
+                x, self.ints_const(starts, "starts"),
+                self.ints_const(ends, "ends"),
+                self.ints_const(axes, "axes"),
+                self.ints_const(steps, "steps")]))
+        if squeeze_axes:
+            stages.append(("Squeeze", lambda x: [
+                x, self.ints_const(squeeze_axes, "axes")]))
+        if unsq_positions:
+            stages.append(("Unsqueeze", lambda x: [
+                x, self.ints_const(unsq_positions, "axes")]))
+        if not stages:  # identity key ([:], ...) — still bind the output
+            stages.append(("Identity", lambda x: [x]))
+        x = ins[0]
+        for i, (op, make_ins) in enumerate(stages):
+            last = i == len(stages) - 1
+            out = outs[0] if last else self.fresh(op.lower())
+            self.nodes.append(_node(op, make_ins(x), [out]))
+            x = out
+
+    def cv_multihead_attention(self, a, ins, outs):
+        """Decompose fused attention into Reshape/Transpose/MatMul/Softmax
+        (the inverse of tpu_passes.fuse_attention). Static shapes make the
+        reshape targets and the causal mask compile-time constants."""
+        if a.get("num_kv_heads") not in (None, a.get("num_heads", 1)):
+            raise MXNetError("ONNX export: grouped-query attention has no "
+                             "single-node ONNX mapping yet")
+        H = int(a.get("num_heads", 1))
+        q, k, v = ins[0], ins[1], ins[2]
+        B, Tq, E = self.shape_of(q)
+        Tk = self.shape_of(k)[1]
+        D = E // H
+        scale = a.get("scale")
+        scale = float(scale) if scale is not None else D ** -0.5
+
+        def split_heads(x, t, perm):
+            r = self.fresh("rs")
+            self.nodes.append(_node(
+                "Reshape", [x, self.ints_const((B, t, H, D), "shape")], [r]))
+            tr = self.fresh("tr")
+            self.nodes.append(_node("Transpose", [r], [tr],
+                                    _attr_ints("perm", perm)))
+            return tr
+
+        qh = split_heads(q, Tq, (0, 2, 1, 3))       # (B,H,Tq,D)
+        kt = split_heads(k, Tk, (0, 2, 3, 1))       # (B,H,D,Tk)
+        vh = split_heads(v, Tk, (0, 2, 1, 3))       # (B,H,Tk,D)
+        logits = self.fresh("lg")
+        self.nodes.append(_node("MatMul", [qh, kt], [logits]))
+        sc = self.fresh("c")
+        self.add_initializer(sc, onp.asarray(scale, "float32"))
+        scaled = self.fresh("sc")
+        self.nodes.append(_node("Mul", [logits, sc], [scaled]))
+        if a.get("causal"):
+            # bottom-right-aligned additive mask, baked (shapes static)
+            m = onp.where(onp.tril(onp.ones((Tq, Tk), bool), Tk - Tq),
+                          0.0, -1e30).astype("float32")
+            mn = self.fresh("causal")
+            self.add_initializer(mn, m)
+            t = self.fresh("ad")
+            self.nodes.append(_node("Add", [scaled, mn], [t]))
+            scaled = t
+        if len(ins) > 3:
+            # additive form of the 0/1 mask: (mask - 1) * 1e30
+            one = self.fresh("c")
+            self.add_initializer(one, onp.asarray(1.0, "float32"))
+            big = self.fresh("c")
+            self.add_initializer(big, onp.asarray(1e30, "float32"))
+            t1, t2, t3 = self.fresh(), self.fresh(), self.fresh()
+            self.nodes.append(_node("Sub", [ins[3], one], [t1]))
+            self.nodes.append(_node("Mul", [t1, big], [t2]))
+            self.nodes.append(_node("Add", [scaled, t2], [t3]))
+            scaled = t3
+        w = self.fresh("sm")
+        self.nodes.append(_node("Softmax", [scaled], [w],
+                                _attr_i("axis", -1)))
+        ctx = self.fresh("ctx")
+        self.nodes.append(_node("MatMul", [w, vh], [ctx]))
+        tr = self.fresh("tr")
+        self.nodes.append(_node("Transpose", [ctx], [tr],
+                                _attr_ints("perm", (0, 2, 1, 3))))
+        self.nodes.append(_node(
+            "Reshape", [tr, self.ints_const((B, Tq, E), "shape")], outs))
+
+    def cv_multibox_prior(self, a, ins, outs):
+        """Anchors depend only on the feature-map shape — compute them at
+        export time and bake the result as an initializer (reference
+        exports MultiBoxPrior as a node; inference graphs gain nothing
+        from re-deriving a constant)."""
+        from ...ops.registry import get_op
+
+        shape = self.shape_of(ins[0])
+        fn = get_op("multibox_prior").fn(**a)
+        anchors = onp.asarray(fn(onp.zeros(shape, "float32")))
+        self.add_initializer(outs[0], anchors)
+
+    def cv_rnn(self, a, ins, outs):
+        """Fused LSTM stack -> one ONNX LSTM node per layer. Gate-order
+        fix-up (ours ifgo -> ONNX iofc) happens numerically on the weight
+        initializers; non-param weights cannot be reordered at export."""
+        mode = a.get("mode", "lstm")
+        if mode != "lstm":
+            raise MXNetError(f"ONNX export: rnn mode {mode!r} not mapped "
+                             "yet (LSTM only)")
+        L = int(a.get("num_layers", 1))
+        nd = 2 if a.get("bidirectional") else 1
+        hidden = int(a.get("hidden_size", 0))
+        x, h0, c0 = ins[0], ins[1], ins[2]
+        weights = ins[3:]
+
+        def perm_gates(arr):      # rows (4H, ...) our i,f,g,o -> iofc
+            Hh = arr.shape[0] // 4
+            return onp.concatenate([arr[:Hh], arr[3 * Hh:],
+                                    arr[Hh:2 * Hh], arr[2 * Hh:3 * Hh]])
+
+        def param(name):
+            if name not in self.params:
+                raise MXNetError(
+                    "ONNX export: rnn weights must be parameters "
+                    f"({name!r} is a computed tensor)")
+            return onp.asarray(self.params[name], "float32")
+
+        def state_slice(src, layer, hint):
+            t = self.fresh(hint)
+            self.nodes.append(_node(
+                "Slice", [src, self.ints_const([layer * nd], "starts"),
+                          self.ints_const([(layer + 1) * nd], "ends"),
+                          self.ints_const([0], "axes")], [t]))
+            return t
+
+        y = x
+        h_parts, c_parts = [], []
+        for layer in range(L):
+            ws, rs, bs = [], [], []
+            for d in range(nd):
+                li = layer * nd + d
+                w_ih, w_hh, b_ih, b_hh = (param(weights[li * 4 + j])
+                                          for j in range(4))
+                ws.append(perm_gates(w_ih))
+                rs.append(perm_gates(w_hh))
+                bs.append(onp.concatenate([perm_gates(b_ih),
+                                           perm_gates(b_hh)]))
+            wn, rn, bn = (self.fresh(h) for h in ("W", "R", "B"))
+            self.add_initializer(wn, onp.stack(ws))
+            self.add_initializer(rn, onp.stack(rs))
+            self.add_initializer(bn, onp.stack(bs))
+            yl, yh, yc = (self.fresh(h) for h in ("Y", "Yh", "Yc"))
+            lstm_ins = [y, wn, rn, bn, "",
+                        state_slice(h0, layer, "h0"),
+                        state_slice(c0, layer, "c0")]
+            attrs = _attr_i("hidden_size", hidden)
+            if nd == 2:
+                attrs += P.w_msg(5, P.w_string(1, "direction") +
+                                 P.w_bytes(4, b"bidirectional") +
+                                 P.w_varint(20, 3))
+            self.nodes.append(_node("LSTM", lstm_ins, [yl, yh, yc], attrs))
+            h_parts.append(yh)
+            c_parts.append(yc)
+            # Y: (T, nd, B, H) -> (T, B, nd*H) for the next layer / output
+            tr = self.fresh("tr")
+            self.nodes.append(_node("Transpose", [yl], [tr],
+                                    _attr_ints("perm", (0, 2, 1, 3))))
+            rsh = self.fresh("rs")
+            T, B = self.shape_of(x)[0], self.shape_of(x)[1]
+            self.nodes.append(_node(
+                "Reshape", [tr, self.ints_const((T, B, nd * hidden),
+                                                "shape")], [rsh]))
+            y = rsh
+        self.nodes.append(_node("Identity", [y], [outs[0]]))
+        if len(outs) > 1:
+            if len(h_parts) == 1:
+                self.nodes.append(_node("Identity", h_parts, [outs[1]]))
+            else:
+                self.nodes.append(_node("Concat", h_parts, [outs[1]],
+                                        _attr_i("axis", 0)))
+        if len(outs) > 2:
+            if len(c_parts) == 1:
+                self.nodes.append(_node("Identity", c_parts, [outs[2]]))
+            else:
+                self.nodes.append(_node("Concat", c_parts, [outs[2]],
+                                        _attr_i("axis", 0)))
+
 
 _SIMPLE_OPS = {
     "add": "Add", "subtract": "Sub", "multiply": "Mul",
@@ -245,10 +495,54 @@ _SIMPLE_OPS = {
 }
 
 
+def _infer_node_shapes(nodes, input_shapes, params, input_dtypes, out_name):
+    """Static shape for EVERY op-node output (converters for swapaxes /
+    attention / rnn / slice need ranks and dims, not just graph outputs).
+    One abstract whole-graph evaluation via jax.eval_shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...cached_op import build_executor
+
+    entries = [(n, i) for n in nodes if not (n.is_var or n.is_const)
+               for i in range(n.nout)]
+    if not entries:
+        return {}
+    var_nodes = [n for n in nodes if n.is_var]
+    specs = []
+    for n in var_nodes:
+        if n.name in params:
+            arr = onp.asarray(params[n.name])
+            specs.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+        elif n.name in input_shapes:
+            dt = (input_dtypes or {}).get(n.name, "float32")
+            specs.append(jax.ShapeDtypeStruct(tuple(input_shapes[n.name]),
+                                              jnp.dtype(dt)))
+        else:
+            raise MXNetError(
+                f"ONNX export: variable {n.name!r} has neither a param "
+                "value nor an input shape")
+    fn, uses_rng = build_executor(entries, var_nodes)
+    args = ([jax.ShapeDtypeStruct((2,), jnp.uint32)] if uses_rng else []) \
+        + specs
+    out = jax.eval_shape(fn, *args)
+    shapes = {out_name(n, i): tuple(o.shape)
+              for (n, i), o in zip(entries, out)}
+    for n in var_nodes:
+        shapes[n.name] = tuple(onp.asarray(params[n.name]).shape) \
+            if n.name in params else tuple(input_shapes[n.name])
+    for n in nodes:
+        if n.is_const:
+            shapes[out_name(n, 0)] = tuple(onp.asarray(n.value).shape)
+    return shapes
+
+
 def export_symbol(sym: Symbol, params: dict, input_shapes: dict,
-                  onnx_file_path="model.onnx", producer="mxnet_tpu"):
+                  onnx_file_path="model.onnx", producer="mxnet_tpu",
+                  input_dtypes=None):
     """Write an ONNX ModelProto for ``sym`` with ``params`` baked as
-    initializers. ``input_shapes``: name -> shape for the data inputs."""
+    initializers. ``input_shapes``: name -> shape for the data inputs;
+    ``input_dtypes``: optional name -> dtype (int token inputs etc.)."""
     nodes = topo_sort(sym._entries)
     exp = _Exporter(params)
     names: dict[tuple, str] = {}
@@ -260,6 +554,25 @@ def export_symbol(sym: Symbol, params: dict, input_shapes: dict,
             names[key] = base if idx == 0 else f"{base}_{idx}"
         return names[key]
 
+    for node in nodes:  # pre-assign var/const names used by inference keys
+        if node.is_var:
+            names[(id(node), 0)] = node.name
+        elif node.is_const:
+            names[(id(node), 0)] = f"const_{node.seq}"
+    try:
+        exp.shapes = _infer_node_shapes(nodes, input_shapes, params,
+                                        input_dtypes, out_name)
+    except MXNetError:
+        raise
+    except Exception as e:  # noqa: BLE001 — inference is best-effort
+        # converters that need shapes will raise a targeted error
+        exp.shapes = {}
+        import warnings
+
+        warnings.warn(f"ONNX export: whole-graph shape inference failed "
+                      f"({type(e).__name__}: {e}); rank-dependent "
+                      "converters will reject their ops")
+
     graph_inputs = []
     for node in nodes:
         if node.is_var:
@@ -269,7 +582,8 @@ def export_symbol(sym: Symbol, params: dict, input_shapes: dict,
                 exp.add_initializer(name, onp.asarray(params[name]))
             elif name in input_shapes:
                 graph_inputs.append(
-                    _value_info(name, input_shapes[name]))
+                    _value_info(name, input_shapes[name],
+                                (input_dtypes or {}).get(name, "float32")))
             else:
                 raise MXNetError(
                     f"ONNX export: variable {name!r} has neither a param "
@@ -291,18 +605,12 @@ def export_symbol(sym: Symbol, params: dict, input_shapes: dict,
             outs = [out_name(node, i) for i in range(node.nout)]
             exp.convert(node, ins, outs)
 
-    # typed outputs (spec requires type on graph outputs): infer shapes
-    # through the executor with input + param shapes
-    all_shapes = dict(input_shapes)
-    for pname, arr in params.items():
-        all_shapes[pname] = tuple(onp.asarray(arr).shape)
-    try:
-        _, out_shapes, _ = sym.infer_shape(**all_shapes)
-    except Exception:  # noqa: BLE001 — fall back to untyped names
-        out_shapes = [None] * len(sym._entries)
+    # typed outputs (spec requires type on graph outputs) straight from the
+    # per-node inference above
     graph_outputs = []
-    for (node, idx), oshape in zip(sym._entries, out_shapes):
+    for node, idx in sym._entries:
         nm = out_name(node, idx)
+        oshape = exp.shapes.get(nm)
         if oshape is not None:
             graph_outputs.append(_value_info(nm, oshape))
         else:

@@ -215,6 +215,83 @@ def _convert_node(n, env, params):
         return Symbol.apply_op("layer_norm", *ins,
                                axis=int(a.get("axis", -1)),
                                eps=float(a.get("epsilon", 1e-5)))
+    if op == "Slice":
+        starts = const_of(n["inputs"][1])
+        ends = const_of(n["inputs"][2])
+        if starts is None or ends is None:
+            raise MXNetError("ONNX import: dynamic Slice unsupported")
+        axes = const_of(n["inputs"][3]) if len(n["inputs"]) > 3 else \
+            onp.arange(len(starts))
+        steps = const_of(n["inputs"][4]) if len(n["inputs"]) > 4 else \
+            onp.ones(len(starts), "int64")
+        spec = []
+        by_axis = {int(ax): (int(st), int(en), int(sp))
+                   for ax, st, en, sp in zip(axes, starts, ends, steps)}
+        if any(ax < 0 for ax in by_axis):
+            # legal ONNX (opset>=10) but unresolvable without the input
+            # rank, which this importer does not infer — fail loudly
+            # rather than silently mis-slicing
+            raise MXNetError(
+                f"ONNX import: Slice with negative axes {sorted(by_axis)} "
+                "is not supported (rank unknown at import)")
+        top = max(by_axis) if by_axis else -1
+        for ax in range(top + 1):
+            if ax in by_axis:
+                st, en, sp = by_axis[ax]
+                # INT32_MAX-ish ends mean "to the end" in our spec: None
+                spec.append(("s", st, None if en >= 2 ** 31 - 1 else en,
+                             sp))
+            else:
+                spec.append(("s", None, None, None))
+        return Symbol.apply_op("slice_key", ins[0], spec=tuple(spec))
+    if op == "LSTM":
+        if a.get("direction", "forward") != "forward":
+            raise MXNetError("ONNX import: only forward LSTM is mapped "
+                             f"(direction={a.get('direction')!r})")
+        nd = 1
+        H = int(a["hidden_size"])
+        W = const_of(n["inputs"][1])
+        R = const_of(n["inputs"][2])
+        B = const_of(n["inputs"][3]) if len(n["inputs"]) > 3 and \
+            n["inputs"][3] else None
+        if W is None or R is None:
+            raise MXNetError("ONNX import: LSTM weights must be "
+                             "initializers")
+        if len(n["inputs"]) < 7 or not n["inputs"][5] or \
+                not n["inputs"][6]:
+            raise MXNetError("ONNX import: LSTM requires initial_h and "
+                             "initial_c inputs (exported graphs carry "
+                             "them)")
+        h0, c0 = env[n["inputs"][5]], env[n["inputs"][6]]
+
+        def unperm(arr):          # rows iofc -> our ifgo
+            i, o, f, c = onp.split(onp.asarray(arr, "float32"), 4)
+            return onp.concatenate([i, f, c, o])
+
+        weight_syms = []
+        for d in range(nd):
+            w_ih = unperm(W[d])
+            w_hh = unperm(R[d])
+            if B is not None:
+                b_ih = unperm(B[d][:4 * H])
+                b_hh = unperm(B[d][4 * H:])
+            else:
+                b_ih = onp.zeros(4 * H, "float32")
+                b_hh = onp.zeros(4 * H, "float32")
+            for arr, hint in ((w_ih, "w_ih"), (w_hh, "w_hh"),
+                              (b_ih, "b_ih"), (b_hh, "b_hh")):
+                nm = f"{n['name'] or 'lstm'}_{hint}_d{d}_{len(params)}"
+                params[nm] = arr
+                from ...symbol.symbol import SymNode
+
+                env[nm] = Symbol([(SymNode(name=nm), 0)])
+                weight_syms.append(env[nm])
+        out = Symbol.apply_op("rnn", ins[0], h0, c0, *weight_syms,
+                              mode="lstm", num_layers=1, hidden_size=H,
+                              bidirectional=False, nout=3)
+        # ONNX Y is (T, num_dirs=1, B, H); ours is (T, B, H)
+        y = Symbol.apply_op("expand_dims", out[0], axis=1)
+        return [y, out[1], out[2]]
     raise MXNetError(f"ONNX import: op {op!r} unsupported")
 
 
@@ -233,9 +310,14 @@ def import_model(model_file):
         env[name] = Symbol([(SymNode(name=name), 0)])
     for n in nodes:
         out_sym = _convert_node(n, env, initializers)
-        env[n["outputs"][0]] = out_sym
-        for extra in n["outputs"][1:]:
-            env[extra] = out_sym  # aux outputs alias (BN etc.)
+        if isinstance(out_sym, list):  # true multi-output (LSTM etc.)
+            for name, s in zip(n["outputs"], out_sym):
+                if name:
+                    env[name] = s
+        else:
+            env[n["outputs"][0]] = out_sym
+            for extra in n["outputs"][1:]:
+                env[extra] = out_sym  # aux outputs alias (BN etc.)
     entries = []
     for name in outputs:
         entries.extend(env[name]._entries)

@@ -1,19 +1,34 @@
-"""Text utilities: vocabulary + embeddings (reference: python/mxnet/contrib/
-text — vocab.Vocabulary, embedding.TokenEmbedding).
+"""Text utilities: vocabulary + pretrained token-embedding store.
 
-Zero-egress note: pretrained embedding downloads are unavailable;
-CustomEmbedding loads local files with the same API.
+Reference: python/mxnet/contrib/text/ — vocab.Vocabulary (vocab.py),
+embedding.py's registry (``register``/``create``:40-88), _TokenEmbedding
+(:133), GloVe (:481), FastText (:553), CustomEmbedding (:635),
+CompositeEmbedding (:677).
+
+Zero-egress note: the reference downloads pretrained files on demand;
+this environment cannot, so GloVe/FastText resolve their files under
+``embedding_root`` (default ``$MXTPU_HOME/embeddings``) and raise a typed
+error naming the expected path when absent. File formats, parsing rules
+(first-duplicate wins, 1-element header lines skipped, unknown-token row
+loaded from file when present) and the lookup/update/composite APIs match
+the reference.
 """
 from __future__ import annotations
 
 import collections
+import os
+import warnings
 
 import numpy as onp
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["Vocabulary", "CustomEmbedding", "count_tokens_from_str"]
+__all__ = ["Vocabulary", "TokenEmbedding", "CustomEmbedding", "GloVe",
+           "FastText", "CompositeEmbedding", "register", "create",
+           "get_pretrained_file_names", "count_tokens_from_str"]
+
+UNKNOWN_IDX = 0
 
 
 def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
@@ -30,7 +45,8 @@ class Vocabulary:
     def __init__(self, counter=None, most_freq_count=None, min_freq=1,
                  unknown_token="<unk>", reserved_tokens=None):
         self.unknown_token = unknown_token
-        self._idx_to_token = [unknown_token] + list(reserved_tokens or [])
+        self._reserved_tokens = list(reserved_tokens or [])
+        self._idx_to_token = [unknown_token] + self._reserved_tokens
         if counter is not None:
             pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
             if most_freq_count is not None:
@@ -51,10 +67,14 @@ class Vocabulary:
     def token_to_idx(self):
         return self._token_to_idx
 
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
     def to_indices(self, tokens):
         single = isinstance(tokens, str)
         toks = [tokens] if single else tokens
-        out = [self._token_to_idx.get(t, 0) for t in toks]
+        out = [self._token_to_idx.get(t, UNKNOWN_IDX) for t in toks]
         return out[0] if single else out
 
     def to_tokens(self, indices):
@@ -67,40 +87,282 @@ class Vocabulary:
         return out[0] if single else out
 
 
-class CustomEmbedding:
-    """Embeddings from a local text file: 'token v1 v2 ...' per line
-    (reference: text/embedding.py CustomEmbedding)."""
+# ---------------------------------------------------------------------------
+# embedding registry (reference: embedding.py register/create:40-88)
+# ---------------------------------------------------------------------------
+_EMBEDDINGS: dict[str, type] = {}
 
-    def __init__(self, pretrained_file_path, elem_delim=" ", vocabulary=None):
-        vectors = {}
-        dim = None
-        with open(pretrained_file_path) as f:
-            for line in f:
+
+def register(embedding_cls):
+    """Register a TokenEmbedding subclass under its lowercase class name."""
+    _EMBEDDINGS[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding: ``create('glove',
+    pretrained_file_name='glove.6B.50d.txt')``."""
+    try:
+        cls = _EMBEDDINGS[embedding_name.lower()]
+    except KeyError:
+        raise MXNetError(
+            f"embedding {embedding_name!r} is not registered; known: "
+            f"{sorted(_EMBEDDINGS)}") from None
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names, per embedding or for all of them."""
+    if embedding_name is not None:
+        try:
+            cls = _EMBEDDINGS[embedding_name.lower()]
+        except KeyError:
+            raise MXNetError(
+                f"embedding {embedding_name!r} is not registered; known: "
+                f"{sorted(_EMBEDDINGS)}") from None
+        return list(cls.pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in _EMBEDDINGS.items()
+            if cls.pretrained_file_names}
+
+
+class TokenEmbedding(Vocabulary):
+    """Pretrained token embedding: a Vocabulary whose indices also map to
+    vectors (reference: embedding.py _TokenEmbedding:133). Index 0 is the
+    unknown token; its vector comes from the file when the file carries the
+    unknown token, else from ``init_unknown_vec``."""
+
+    pretrained_file_names: tuple = ()
+
+    def __init__(self, unknown_token="<unk>"):
+        super().__init__(unknown_token=unknown_token)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- loading ------------------------------------------------------------
+    def _load_embedding(self, path, elem_delim=" ",
+                        init_unknown_vec=onp.zeros, encoding="utf-8"):
+        path = os.path.expanduser(path)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                f"pretrained embedding file not found: {path}")
+        rows, vec_len, loaded_unknown = [], None, None
+        with open(path, encoding=encoding) as f:
+            for num, line in enumerate(f, 1):
                 parts = line.rstrip().split(elem_delim)
                 if len(parts) < 2:
                     continue
-                token, vals = parts[0], [float(v) for v in parts[1:]]
-                if dim is None:
-                    dim = len(vals)
-                if len(vals) == dim:
-                    vectors[token] = vals
-        self.vec_len = dim or 0
-        if vocabulary is None:
-            counter = collections.Counter({t: 1 for t in vectors})
-            vocabulary = Vocabulary(counter)
-        self.vocabulary = vocabulary
-        table = onp.zeros((len(vocabulary), self.vec_len), dtype="float32")
-        for token, vals in vectors.items():
-            idx = vocabulary.token_to_idx.get(token)
-            if idx is not None:
-                table[idx] = vals
-        self.idx_to_vec = NDArray(table)
+                token, vals = parts[0], parts[1:]
+                if len(vals) == 1:
+                    # fastText-style "count dim" header (reference skips
+                    # 1-element vectors as likely headers, :276-280)
+                    warnings.warn(f"line {num}: token {token!r} with a "
+                                  "1-element vector looks like a header; "
+                                  "skipped")
+                    continue
+                vec = [float(v) for v in vals]
+                if token == self.unknown_token and loaded_unknown is None:
+                    loaded_unknown = vec
+                    continue
+                if token in self._token_to_idx:
+                    warnings.warn(f"line {num}: duplicate embedding for "
+                                  f"{token!r} skipped (first one wins)")
+                    continue
+                if vec_len is None:
+                    vec_len = len(vec)
+                elif len(vec) != vec_len:
+                    raise MXNetError(
+                        f"line {num}: token {token!r} has dimension "
+                        f"{len(vec)} but previous tokens have {vec_len}")
+                rows.append(vec)
+                self._idx_to_token.append(token)
+                self._token_to_idx[token] = len(self._idx_to_token) - 1
+        if vec_len is None and loaded_unknown is not None:
+            vec_len = len(loaded_unknown)  # file holds only the unk row
+        self._vec_len = vec_len or 0
+        if loaded_unknown is not None and len(loaded_unknown) != \
+                self._vec_len and rows:
+            raise MXNetError(
+                f"the {self.unknown_token!r} row has dimension "
+                f"{len(loaded_unknown)} but other tokens have "
+                f"{self._vec_len}")
+        table = onp.zeros((len(self._idx_to_token), self._vec_len),
+                          dtype="float32")
+        if rows:
+            table[len(self._idx_to_token) - len(rows):] = rows
+        table[UNKNOWN_IDX] = loaded_unknown if loaded_unknown is not None \
+            else init_unknown_vec(self._vec_len)
+        self._idx_to_vec = NDArray(table)
 
-    def get_vecs_by_tokens(self, tokens):
-        idx = self.vocabulary.to_indices(tokens)
-        single = isinstance(idx, int)
+    def _build_for_vocabulary(self, vocabulary):
+        """Re-index so row i holds the vector of ``vocabulary``'s token i
+        (reference: _build_embedding_for_vocabulary:349)."""
+        if vocabulary is None:
+            return
+        if not isinstance(vocabulary, Vocabulary):
+            raise MXNetError("vocabulary must be a contrib.text.Vocabulary")
+        self._set_vecs_from([self], vocabulary)
+
+    def _set_vecs_from(self, embeddings, vocabulary):
+        """Concatenate ``embeddings``' vectors per vocabulary token
+        (reference: _set_idx_to_vec_by_embeddings:317) and adopt the
+        vocabulary's indexing."""
+        vec_len = sum(e.vec_len for e in embeddings)
+        table = onp.zeros((len(vocabulary), vec_len), dtype="float32")
+        col = 0
+        for e in embeddings:
+            end = col + e.vec_len
+            table[UNKNOWN_IDX, col:end] = \
+                e.idx_to_vec.asnumpy()[UNKNOWN_IDX]
+            if len(vocabulary) > 1:
+                table[1:, col:end] = e.get_vecs_by_tokens(
+                    vocabulary.idx_to_token[1:]).asnumpy()
+            col = end
+        self._vec_len = vec_len
+        self._idx_to_vec = NDArray(table)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self.unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = list(vocabulary.reserved_tokens)
+
+    # -- lookup / update ----------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for a token (1-D) or token list (2-D); unknown tokens
+        get row 0. With ``lower_case_backup`` a miss retries lowercased."""
         import jax.numpy as jnp
 
-        rows = self.idx_to_vec._data[jnp.asarray([idx] if single else idx)]
-        out = NDArray(rows[0] if single else rows)
-        return out
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            idx = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), UNKNOWN_IDX))
+                for t in toks]
+        else:
+            idx = [self._token_to_idx.get(t, UNKNOWN_IDX) for t in toks]
+        rows = self._idx_to_vec._data[jnp.asarray(idx, jnp.int32)]
+        return NDArray(rows[0] if single else rows)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of known tokens; unknown tokens are rejected
+        to avoid silent no-ops (reference: update_token_vectors:415)."""
+        single = not isinstance(tokens, list)
+        toks = [tokens] if single else tokens
+        idx = []
+        for t in toks:
+            if t not in self._token_to_idx:
+                raise MXNetError(
+                    f"token {t!r} is unknown; to update the unknown "
+                    f"token's vector pass {self.unknown_token!r} itself")
+            idx.append(self._token_to_idx[t])
+        vals = new_vectors._data if isinstance(new_vectors, NDArray) \
+            else onp.asarray(new_vectors, dtype="float32")
+        vals = vals.reshape(len(idx), self._vec_len)
+        import jax.numpy as jnp
+
+        self._idx_to_vec._set_data(
+            self._idx_to_vec._data.at[jnp.asarray(idx, jnp.int32)]
+            .set(jnp.asarray(vals)))
+
+    @classmethod
+    def _check_pretrained_file(cls, name):
+        if cls.pretrained_file_names and name not in \
+                cls.pretrained_file_names:
+            raise MXNetError(
+                f"unknown pretrained file {name!r} for "
+                f"{cls.__name__.lower()}; valid: "
+                f"{', '.join(cls.pretrained_file_names)}")
+
+    @classmethod
+    def _resolve_pretrained(cls, embedding_root, file_name):
+        root = embedding_root or os.path.join(
+            os.environ.get("MXTPU_HOME",
+                           os.path.join(os.path.expanduser("~"),
+                                        ".mxtpu")), "embeddings")
+        path = os.path.join(root, cls.__name__.lower(), file_name)
+        if not os.path.isfile(path):
+            raise MXNetError(
+                f"pretrained file {file_name!r} not found at {path}. This "
+                "environment has no network egress (the reference would "
+                "download it); place the file there and retry.")
+        return path
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe embeddings from a local file in 'token v1 .. vd' format
+    (reference: embedding.py GloVe:481)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=None, init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._check_pretrained_file(pretrained_file_name)
+        path = self._resolve_pretrained(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText ``.vec`` embeddings from a local file; the count/dim header
+    line is skipped (reference: embedding.py FastText:553)."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.fr.vec",
+        "wiki.de.vec", "wiki.es.vec", "wiki.ja.vec", "wiki.ru.vec",
+        "crawl-300d-2M.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._check_pretrained_file(pretrained_file_name)
+        path = self._resolve_pretrained(embedding_root, pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_for_vocabulary(vocabulary)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embeddings from any local 'token<delim>v1<delim>...' file
+    (reference: embedding.py CustomEmbedding:635)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf-8", init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        self._build_for_vocabulary(vocabulary)
+
+
+@register
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenates several embeddings' vectors per token of one vocabulary
+    (reference: embedding.py CompositeEmbedding:677)."""
+
+    def __init__(self, vocabulary, token_embeddings, **kwargs):
+        super().__init__(**kwargs)
+        if not isinstance(vocabulary, Vocabulary):
+            raise MXNetError("vocabulary must be a contrib.text.Vocabulary")
+        embeds = token_embeddings if isinstance(token_embeddings, list) \
+            else [token_embeddings]
+        for e in embeds:
+            if not isinstance(e, TokenEmbedding):
+                raise MXNetError("token_embeddings must be TokenEmbedding "
+                                 f"instances (got {type(e).__name__})")
+        self._set_vecs_from(embeds, vocabulary)

@@ -141,15 +141,9 @@ def _shutdown_pool(task_q, result_q, procs):
 def _worker_loop(dataset_pkl, batchify_pkl, task_q, result_q):
     """Spawned worker entry: pinned to CPU before jax can initialize, so a
     worker can never race the parent for the TPU runtime."""
-    import os
+    from ...context import pin_process_to_cpu
 
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:  # noqa: BLE001 — jax optional in pure-numpy workers
-        pass
+    pin_process_to_cpu()
     dataset = pickle.loads(dataset_pkl)
     batchify = pickle.loads(batchify_pkl)
     while True:
@@ -257,18 +251,11 @@ class DataLoader:
         # children inherit the env at exec time — pin them to CPU BEFORE
         # they re-import the parent's __main__ (which may pull in jax and
         # otherwise initialize the TPU runtime inside the worker)
-        import os
+        from ...context import spawn_cpu_pinned_env
 
-        prev = os.environ.get("JAX_PLATFORMS")
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        try:
+        with spawn_cpu_pinned_env():
             for p in procs:
                 p.start()
-        finally:
-            if prev is None:
-                del os.environ["JAX_PLATFORMS"]
-            else:
-                os.environ["JAX_PLATFORMS"] = prev
         self._pool = (task_q, result_q, procs)
         weakref.finalize(self, _shutdown_pool, task_q, result_q, procs)
         return self._pool

@@ -40,6 +40,25 @@ def _keys_vals(key, value):
     return [key], [value]
 
 
+def _merge_row_sparse(vlist):
+    """Sum a list of RowSparseNDArrays into one with unique sorted rows
+    (reference: server-side sparse merge, kvstore_dist_server.h:346, and
+    kvstore_local.h Unique). Eager — unique is data-dependent-shaped."""
+    import jax.numpy as jnp
+
+    from ..ndarray.sparse import RowSparseNDArray
+
+    vlist = _as_list(vlist)
+    shape = vlist[0].shape
+    idx = jnp.concatenate([v.indices._data.astype(jnp.int32)
+                           for v in vlist])
+    dat = jnp.concatenate([v.data._data for v in vlist])
+    uniq, inv = jnp.unique(idx, return_inverse=True)
+    summed = jnp.zeros((int(uniq.shape[0]),) + dat.shape[1:],
+                       dat.dtype).at[inv].add(dat)
+    return RowSparseNDArray(NDArray(summed), NDArray(uniq), shape)
+
+
 @KVStoreBase.register
 class KVStore(KVStoreBase):
     """Single-process store ('local'/'device'): sum-reduce on device."""
@@ -121,7 +140,36 @@ class KVStore(KVStoreBase):
         return acc
 
     def push(self, key, value, priority=0):
+        from ..ndarray.sparse import RowSparseNDArray
+
         keys, vals = _keys_vals(key, value)
+        # row_sparse pushes stay sparse end-to-end in-process: merged rows
+        # go straight to the optimizer's lazy _apply_sparse path — the
+        # embedding-gradient flow (reference: sparse FComputeEx update
+        # kernels + server-side sparse merge). Multi-worker sparse pushes
+        # densify (cross-host collectives are dense buckets here).
+        sparse = {i for i, v in enumerate(vals)
+                  if any(isinstance(x, RowSparseNDArray)
+                         for x in _as_list(v))}
+        if sparse and self.num_workers == 1:
+            for i in sorted(sparse):
+                k, merged = keys[i], _merge_row_sparse(vals[i])
+                if self._updater is not None and k in self._store:
+                    self._updater(k, merged, self._store[k])
+                elif k in self._store:
+                    w = self._store[k]._data
+                    w = w.at[merged.indices._data].set(merged.data._data)
+                    self._store[k]._set_data(w)
+                else:
+                    self._store[k] = merged.todense()
+            keys = [k for i, k in enumerate(keys) if i not in sparse]
+            vals = [v for i, v in enumerate(vals) if i not in sparse]
+            if not keys:
+                return
+        elif sparse:
+            vals = [[x.todense() if isinstance(x, RowSparseNDArray) else x
+                     for x in _as_list(v)] if i in sparse else v
+                    for i, v in enumerate(vals)]
         # reduce locally, then across workers in ONE batched collective per
         # dtype bucket (reference: server-side merge of all workers' pushes,
         # kvstore_dist_server.h:346; bucketing analog: P3's sliced pushes)
@@ -178,8 +226,38 @@ class KVStore(KVStoreBase):
         self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Dense emulation of PullRowSparse (reference kvstore.h:264)."""
-        self.pull(key, out, priority)
+        """Pull only the requested rows as RowSparseNDArrays — a real HBM
+        gather, NOT a dense pull (reference: kvstore.h:264 PullRowSparse;
+        kvstore_local.h:70 unique row_ids then per-row copy). ``row_ids``
+        need not be unique or sorted; the result rows are unique+sorted.
+        With ``row_ids=None`` this degrades to a dense pull for
+        back-compat with pre-round-5 callers."""
+        if row_ids is None:
+            return self.pull(key, out, priority)
+        import jax.numpy as jnp
+
+        from ..ndarray.sparse import RowSparseNDArray
+
+        keys, outs = _keys_vals(key, out)
+        rids = list(row_ids) if isinstance(row_ids, (list, tuple)) \
+            else [row_ids] * len(keys)
+        if len(rids) != len(keys):
+            raise MXNetError(
+                f"row_sparse_pull: {len(keys)} keys but {len(rids)} row_ids")
+        for k, o, r in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError(f"kvstore key {k!r} was never init'd/pushed")
+            table = self._store[k]._data
+            rid = jnp.unique(r._data.astype(jnp.int32))
+            vals = table[rid]  # device gather of just these rows
+            for dst in _as_list(o):
+                if not isinstance(dst, RowSparseNDArray):
+                    raise MXNetError(
+                        "row_sparse_pull outputs must be RowSparseNDArray "
+                        f"(got {type(dst).__name__})")
+                dst.indices._set_data(rid)
+                dst.data._set_data(vals)
+                dst._shape = tuple(table.shape)
 
     # -- optimizer-on-store (reference: update_on_kvstore) -------------------
     def set_optimizer(self, optimizer):

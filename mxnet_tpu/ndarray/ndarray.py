@@ -67,6 +67,9 @@ class NDArray:
     def __init__(self, data):
         import jax
 
+        from ..context import ensure_backend
+
+        ensure_backend()  # first device touch goes through the safe probe
         if not isinstance(data, jax.Array):
             import jax.numpy as jnp
 

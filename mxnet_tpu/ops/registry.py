@@ -168,9 +168,11 @@ def invoke(op: Op, inputs, attrs=None, out=None):
     (async via XLA), record autograd tape / deferred-compute graph as needed.
     """
     from ..ndarray.ndarray import NDArray
+    from ..context import ensure_backend
     from .. import autograd as ag
     from .. import _deferred_compute as dc
 
+    ensure_backend()  # dict hit after the first call (see context.py)
     attrs = attrs or {}
     from .. import amp as _amp
 

@@ -11,6 +11,10 @@ import os
 # driver's __graft_entry__ checks). jax may already be imported (and the env
 # var consumed) by a site hook, so set the config directly too.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# out-of-band pin for SUBPROCESSES spawned by tests: a site hook may rewrite
+# JAX_PLATFORMS/jax.config in every child interpreter, but leaves MXTPU_*
+# alone — mxnet_tpu.context.default_backend honors this var first
+os.environ["MXTPU_FORCE_CPU"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +

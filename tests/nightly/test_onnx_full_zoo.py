@@ -1,0 +1,43 @@
+"""Nightly: numerical ONNX round-trip of EVERY registered zoo model
+(reference: tests covering onnx/mx2onnx/_op_translations breadth). The
+default suite runs one representative per family (tests/test_contrib.py);
+this sweep includes the deep/wide variants whose export files reach
+hundreds of MB."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _all_zoo_names():
+    import mxnet_tpu.gluon.model_zoo.vision as V
+
+    return sorted(V._models)
+
+
+@pytest.mark.parametrize("name", _all_zoo_names())
+def test_onnx_roundtrip_every_zoo_model(name, tmp_path):
+    from mxnet_tpu.contrib import onnx as mxonnx
+    from mxnet_tpu.gluon.model_zoo import get_model
+
+    shape = {"mlp": (1, 784), "inceptionv3": (1, 3, 299, 299),
+             "ssd_256_lite": (1, 3, 256, 256),
+             "ssd_300_mobilenet": (1, 3, 300, 300)}.get(name,
+                                                        (1, 3, 224, 224))
+    net = get_model(name)
+    net.initialize()
+    x = np.array(onp.random.RandomState(0).randn(*shape).astype("float32"))
+    with mx.autograd.predict_mode():
+        ref = net(x)
+    refs = [t.asnumpy() for t in
+            (ref if isinstance(ref, (tuple, list)) else [ref])]
+    path = mxonnx.export_model(net, input_shape=shape,
+                               onnx_file_path=str(tmp_path / "m.onnx"))
+    blk = mxonnx.import_to_gluon(path)
+    got = blk(x)
+    gots = [t.asnumpy() for t in
+            (got if isinstance(got, (tuple, list)) else [got])]
+    for a, b in zip(refs, gots):
+        assert_almost_equal(b, a, rtol=1e-4, atol=1e-4)
