@@ -7,16 +7,19 @@ pooling (incl. global/ceil), BatchNorm (inference), activations (relu/
 sigmoid/tanh/leaky/elu/gelu-by-erf), softmax/log_softmax, LayerNorm,
 reshape/flatten/transpose/swapaxes/concat/squeeze/unsqueeze,
 Gather/embedding, static basic indexing (slice_key -> Slice/Squeeze/
-Unsqueeze), fused LSTM stacks (one ONNX LSTM per layer, ifgo<->iofc gate
-reorder on the weight initializers), fused multihead_attention (decomposed
-to Reshape/Transpose/MatMul/Softmax with baked causal / additive key
-masks), multibox_prior (anchors baked as initializers — shape-only
-constants in inference graphs), elementwise arithmetic, dropout (exported
-as Identity). This closes the model zoo: every registered vision model,
-the word-LM LSTM and BERT round-trip numerically (tests/test_contrib.py
-representatives; tests/nightly/test_onnx_full_zoo.py sweeps all). Known
-gaps: GRU/vanilla-RNN export, bidirectional LSTM import, grouped-query
-attention, advanced (array) indexing. Ops outside the set raise MXNetError
+Unsqueeze), fused recurrent stacks — LSTM/GRU/vanilla-RNN, uni- and
+bidirectional, one ONNX node per layer with numeric gate reorders
+(ifgo<->iofc, rzn<->zrh; our GRU declares linear_before_reset=1) — fused
+multihead_attention (decomposed to Reshape/Transpose/MatMul/Softmax with
+baked causal / additive key masks), multibox_prior (anchors baked as
+initializers — shape-only constants in inference graphs), elementwise
+arithmetic, dropout (exported as Identity). This closes the model zoo:
+every registered vision model, the word-LM LSTM, the GRU/RNN/bi-LSTM
+family and BERT round-trip numerically (tests/test_contrib.py
+representatives; tests/nightly/test_onnx_full_zoo.py sweeps all).
+Grouped-query attention exports via an Expand-based kv-head repeat, and
+single-array advanced indexing maps to Gather. Known gaps: multi-array /
+mixed advanced indexing, GRU-with-linear_before_reset=0 import. Ops outside the set raise MXNetError
 naming the op. If a real ``onnx`` package is present it is NOT required —
 files round-trip through this codec (and a skipped-unless-available test
 validates through the real checker/runtime when the package exists).

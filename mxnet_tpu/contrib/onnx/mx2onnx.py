@@ -45,6 +45,18 @@ def _attr_ints(name, values) -> bytes:
     return P.w_msg(5, body)
 
 
+def _attr_s(name, value) -> bytes:
+    return P.w_msg(5, P.w_string(1, name) +
+                   P.w_bytes(4, value.encode()) + P.w_varint(20, 3))
+
+
+def _attr_strs(name, values) -> bytes:
+    body = P.w_string(1, name) + \
+        b"".join(P.w_bytes(9, v.encode()) for v in values) + \
+        P.w_varint(20, 8)
+    return P.w_msg(5, body)
+
+
 def _node(op_type, inputs, outputs, attrs=b"", name="") -> bytes:
     payload = b"".join(P.w_string(1, i) for i in inputs)
     payload += b"".join(P.w_string(2, o) for o in outputs)
@@ -258,12 +270,31 @@ class _Exporter:
 
     def cv_slice_key(self, a, ins, outs):
         """Static basic indexing (ints/slices/ellipsis/None) as ONNX
-        Slice + Squeeze + Unsqueeze. Advanced (array) indices would arrive
-        as extra inputs — unsupported here."""
-        if len(ins) > 1:
-            raise MXNetError("ONNX export: advanced (array) indexing has "
-                             "no ONNX mapping; rewrite with take/gather")
+        Slice + Squeeze + Unsqueeze. The embedding-style advanced case —
+        exactly ONE index array, every other entry a full slice — maps to
+        Gather on that axis; mixed/multi-array advanced indexing has no
+        clean ONNX mapping and raises."""
         spec = a.get("spec", ())
+        if len(ins) > 1:
+            arr_positions = [i for i, s in enumerate(spec) if s[0] == "a"]
+            others_full = all(
+                s[0] == "e" or (s[0] == "s" and s[1] is None and
+                                s[2] is None and s[3] in (None, 1))
+                for s in spec if s[0] != "a")
+            if len(ins) != 2 or len(arr_positions) != 1 or not others_full:
+                raise MXNetError(
+                    "ONNX export: only single-array advanced indexing "
+                    "(x[..., idx, ...] with full slices elsewhere) maps "
+                    "to Gather; rewrite other patterns with take/gather")
+            before = spec[:arr_positions[0]]
+            axis = sum(1 for s in before if s[0] == "s")
+            if any(s[0] == "e" for s in before):
+                rank = len(self.shape_of(ins[0]))
+                n_real = sum(1 for s in spec if s[0] in ("s", "i", "a"))
+                axis += rank - n_real
+            self.nodes.append(_node("Gather", [ins[0], ins[1]], outs,
+                                    _attr_i("axis", axis)))
+            return
         shape = self.shape_of(ins[0])
         rank = len(shape)
         n_real = sum(1 for s in spec if s[0] in ("s", "i"))
@@ -324,11 +355,12 @@ class _Exporter:
     def cv_multihead_attention(self, a, ins, outs):
         """Decompose fused attention into Reshape/Transpose/MatMul/Softmax
         (the inverse of tpu_passes.fuse_attention). Static shapes make the
-        reshape targets and the causal mask compile-time constants."""
-        if a.get("num_kv_heads") not in (None, a.get("num_heads", 1)):
-            raise MXNetError("ONNX export: grouped-query attention has no "
-                             "single-node ONNX mapping yet")
+        reshape targets and the causal mask compile-time constants.
+        Grouped-query attention materializes the kv-head repeat with an
+        Expand (matching the op's jnp.repeat semantics)."""
         H = int(a.get("num_heads", 1))
+        n_kv = a.get("num_kv_heads")
+        n_kv = H if n_kv is None else int(n_kv)
         q, k, v = ins[0], ins[1], ins[2]
         B, Tq, E = self.shape_of(q)
         Tk = self.shape_of(k)[1]
@@ -336,18 +368,42 @@ class _Exporter:
         scale = a.get("scale")
         scale = float(scale) if scale is not None else D ** -0.5
 
-        def split_heads(x, t, perm):
+        def split_heads(x, t, perm, nheads=H):
             r = self.fresh("rs")
             self.nodes.append(_node(
-                "Reshape", [x, self.ints_const((B, t, H, D), "shape")], [r]))
+                "Reshape", [x, self.ints_const((B, t, nheads, D),
+                                               "shape")], [r]))
             tr = self.fresh("tr")
             self.nodes.append(_node("Transpose", [r], [tr],
                                     _attr_ints("perm", perm)))
             return tr
 
+        def repeat_kv(x, t):
+            """(B, n_kv, t, D) -> (B, H, t, D): each kv head repeated
+            H//n_kv times consecutively (jnp.repeat axis=1 semantics)."""
+            if n_kv == H:
+                return x
+            reps = H // n_kv
+            r1 = self.fresh("rs")
+            self.nodes.append(_node(
+                "Reshape", [x, self.ints_const((B, n_kv, 1, t, D),
+                                               "shape")], [r1]))
+            ex = self.fresh("ex")
+            self.nodes.append(_node(
+                "Expand", [r1, self.ints_const((B, n_kv, reps, t, D),
+                                               "shape")], [ex]))
+            r2 = self.fresh("rs")
+            self.nodes.append(_node(
+                "Reshape", [ex, self.ints_const((B, H, t, D), "shape")],
+                [r2]))
+            return r2
+
         qh = split_heads(q, Tq, (0, 2, 1, 3))       # (B,H,Tq,D)
-        kt = split_heads(k, Tk, (0, 2, 3, 1))       # (B,H,D,Tk)
-        vh = split_heads(v, Tk, (0, 2, 1, 3))       # (B,H,Tk,D)
+        kh = repeat_kv(split_heads(k, Tk, (0, 2, 1, 3), n_kv), Tk)
+        vh = repeat_kv(split_heads(v, Tk, (0, 2, 1, 3), n_kv), Tk)
+        kt = self.fresh("tr")                        # (B,H,D,Tk)
+        self.nodes.append(_node("Transpose", [kh], [kt],
+                                 _attr_ints("perm", (0, 1, 3, 2))))
         logits = self.fresh("lg")
         self.nodes.append(_node("MatMul", [qh, kt], [logits]))
         sc = self.fresh("c")
@@ -398,23 +454,37 @@ class _Exporter:
         self.add_initializer(outs[0], anchors)
 
     def cv_rnn(self, a, ins, outs):
-        """Fused LSTM stack -> one ONNX LSTM node per layer. Gate-order
-        fix-up (ours ifgo -> ONNX iofc) happens numerically on the weight
-        initializers; non-param weights cannot be reordered at export."""
+        """Fused recurrent stack -> one ONNX LSTM/GRU/RNN node per layer.
+        Gate-order fix-ups (ours ifgo -> ONNX iofc; ours rzn -> ONNX zrh)
+        happen numerically on the weight initializers; our GRU is the
+        linear_before_reset=1 formulation, declared as such."""
         mode = a.get("mode", "lstm")
-        if mode != "lstm":
-            raise MXNetError(f"ONNX export: rnn mode {mode!r} not mapped "
-                             "yet (LSTM only)")
+        is_lstm = mode == "lstm"
         L = int(a.get("num_layers", 1))
         nd = 2 if a.get("bidirectional") else 1
         hidden = int(a.get("hidden_size", 0))
-        x, h0, c0 = ins[0], ins[1], ins[2]
-        weights = ins[3:]
+        x, h0 = ins[0], ins[1]
+        c0 = ins[2] if is_lstm else None
+        weights = ins[3:] if is_lstm else ins[2:]
+        if mode == "lstm":
+            op_type = "LSTM"
 
-        def perm_gates(arr):      # rows (4H, ...) our i,f,g,o -> iofc
-            Hh = arr.shape[0] // 4
-            return onp.concatenate([arr[:Hh], arr[3 * Hh:],
-                                    arr[Hh:2 * Hh], arr[2 * Hh:3 * Hh]])
+            def perm(arr):        # rows (4H, ...) our i,f,g,o -> iofc
+                i, f, g, o = onp.split(arr, 4)
+                return onp.concatenate([i, o, f, g])
+        elif mode == "gru":
+            op_type = "GRU"
+
+            def perm(arr):        # rows (3H, ...) our r,z,n -> zrh
+                r, z, n = onp.split(arr, 3)
+                return onp.concatenate([z, r, n])
+        elif mode in ("rnn_relu", "rnn_tanh"):
+            op_type = "RNN"
+
+            def perm(arr):
+                return arr
+        else:
+            raise MXNetError(f"ONNX export: rnn mode {mode!r} unsupported")
 
         def param(name):
             if name not in self.params:
@@ -439,29 +509,33 @@ class _Exporter:
                 li = layer * nd + d
                 w_ih, w_hh, b_ih, b_hh = (param(weights[li * 4 + j])
                                           for j in range(4))
-                ws.append(perm_gates(w_ih))
-                rs.append(perm_gates(w_hh))
-                bs.append(onp.concatenate([perm_gates(b_ih),
-                                           perm_gates(b_hh)]))
+                ws.append(perm(w_ih))
+                rs.append(perm(w_hh))
+                bs.append(onp.concatenate([perm(b_ih), perm(b_hh)]))
             wn, rn, bn = (self.fresh(h) for h in ("W", "R", "B"))
             self.add_initializer(wn, onp.stack(ws))
             self.add_initializer(rn, onp.stack(rs))
             self.add_initializer(bn, onp.stack(bs))
-            yl, yh, yc = (self.fresh(h) for h in ("Y", "Yh", "Yc"))
-            lstm_ins = [y, wn, rn, bn, "",
-                        state_slice(h0, layer, "h0"),
-                        state_slice(c0, layer, "c0")]
             attrs = _attr_i("hidden_size", hidden)
             if nd == 2:
-                attrs += P.w_msg(5, P.w_string(1, "direction") +
-                                 P.w_bytes(4, b"bidirectional") +
-                                 P.w_varint(20, 3))
-            self.nodes.append(_node("LSTM", lstm_ins, [yl, yh, yc], attrs))
-            h_parts.append(yh)
-            c_parts.append(yc)
+                attrs += _attr_s("direction", "bidirectional")
+            if mode == "gru":
+                attrs += _attr_i("linear_before_reset", 1)
+            if mode == "rnn_relu":
+                attrs += _attr_strs("activations", ["Relu"] * nd)
+            node_ins = [y, wn, rn, bn, "",
+                        state_slice(h0, layer, "h0")]
+            node_outs = [self.fresh("Y"), self.fresh("Yh")]
+            if is_lstm:
+                node_ins.append(state_slice(c0, layer, "c0"))
+                node_outs.append(self.fresh("Yc"))
+            self.nodes.append(_node(op_type, node_ins, node_outs, attrs))
+            h_parts.append(node_outs[1])
+            if is_lstm:
+                c_parts.append(node_outs[2])
             # Y: (T, nd, B, H) -> (T, B, nd*H) for the next layer / output
             tr = self.fresh("tr")
-            self.nodes.append(_node("Transpose", [yl], [tr],
+            self.nodes.append(_node("Transpose", [node_outs[0]], [tr],
                                     _attr_ints("perm", (0, 2, 1, 3))))
             rsh = self.fresh("rs")
             T, B = self.shape_of(x)[0], self.shape_of(x)[1]
@@ -469,19 +543,19 @@ class _Exporter:
                 "Reshape", [tr, self.ints_const((T, B, nd * hidden),
                                                 "shape")], [rsh]))
             y = rsh
+
+        def bind(parts, out):
+            if len(parts) == 1:
+                self.nodes.append(_node("Identity", parts, [out]))
+            else:
+                self.nodes.append(_node("Concat", parts, [out],
+                                        _attr_i("axis", 0)))
+
         self.nodes.append(_node("Identity", [y], [outs[0]]))
         if len(outs) > 1:
-            if len(h_parts) == 1:
-                self.nodes.append(_node("Identity", h_parts, [outs[1]]))
-            else:
-                self.nodes.append(_node("Concat", h_parts, [outs[1]],
-                                        _attr_i("axis", 0)))
-        if len(outs) > 2:
-            if len(c_parts) == 1:
-                self.nodes.append(_node("Identity", c_parts, [outs[2]]))
-            else:
-                self.nodes.append(_node("Concat", c_parts, [outs[2]],
-                                        _attr_i("axis", 0)))
+            bind(h_parts, outs[1])
+        if is_lstm and len(outs) > 2:
+            bind(c_parts, outs[2])
 
 
 _SIMPLE_OPS = {

@@ -43,6 +43,8 @@ def _parse_attrs(attr_bufs):
             attrs[name] = tuple(P.scalars(fields.get(8, [])))
         elif atype == 6:
             attrs[name] = tuple(P.scalars(fields.get(7, []), "float"))
+        elif atype == 8:  # repeated strings (e.g. RNN activations)
+            attrs[name] = tuple(b.decode() for b in fields.get(9, []))
         elif 3 in fields:
             attrs[name] = fields[3][0]
         elif 8 in fields:
@@ -206,11 +208,20 @@ def _convert_node(n, env, params):
                                    axis=tuple(int(s) for s in axes))
         return Symbol.apply_op("squeeze", ins[0], axis=None)
     if op == "Gather":
-        # (data, indices) -> our embedding order is (indices, weight)
-        if int(a.get("axis", 0)) == 0:
+        # (data, indices) -> our embedding order is (indices, weight).
+        # ONNX Gather wraps negative indices (idx + dim); jnp.take
+        # mode="wrap" (modulo) matches that for all in-range indices,
+        # where mode="clip" would silently send -1 to row 0
+        if int(a.get("axis", 0)) == 0 and n["inputs"][0] in params:
             return Symbol.apply_op("embedding", ins[1], ins[0])
         return Symbol.apply_op("take", ins[0], ins[1],
-                               axis=int(a.get("axis", 0)), mode="clip")
+                               axis=int(a.get("axis", 0)), mode="wrap")
+    if op == "Expand":
+        shape = const_of(n["inputs"][1])
+        if shape is None:
+            raise MXNetError("ONNX import: dynamic Expand unsupported")
+        return Symbol.apply_op("broadcast_to", ins[0],
+                               shape=tuple(int(s) for s in shape))
     if op == "LayerNormalization":
         return Symbol.apply_op("layer_norm", *ins,
                                axis=int(a.get("axis", -1)),
@@ -244,54 +255,97 @@ def _convert_node(n, env, params):
             else:
                 spec.append(("s", None, None, None))
         return Symbol.apply_op("slice_key", ins[0], spec=tuple(spec))
-    if op == "LSTM":
-        if a.get("direction", "forward") != "forward":
-            raise MXNetError("ONNX import: only forward LSTM is mapped "
-                             f"(direction={a.get('direction')!r})")
-        nd = 1
+    if op in ("LSTM", "GRU", "RNN"):
+        direction = a.get("direction", "forward")
+        if direction not in ("forward", "bidirectional"):
+            raise MXNetError(f"ONNX import: {op} direction "
+                             f"{direction!r} unsupported")
+        nd = 2 if direction == "bidirectional" else 1
         H = int(a["hidden_size"])
+        is_lstm = op == "LSTM"
+        if op == "LSTM":
+            mode, ngates = "lstm", 4
+
+            def unperm(arr):      # rows iofc -> our ifgo
+                i, o, f, c = onp.split(onp.asarray(arr, "float32"), 4)
+                return onp.concatenate([i, f, c, o])
+        elif op == "GRU":
+            if not int(a.get("linear_before_reset", 0)):
+                raise MXNetError(
+                    "ONNX import: GRU with linear_before_reset=0 has no "
+                    "mapping (our recurrence is the =1 formulation)")
+            mode, ngates = "gru", 3
+
+            def unperm(arr):      # rows zrh -> our rzn
+                z, r, h = onp.split(onp.asarray(arr, "float32"), 3)
+                return onp.concatenate([r, z, h])
+        else:
+            acts = a.get("activations", ())
+            acts = [v.decode() if isinstance(v, bytes) else str(v)
+                    for v in (acts if isinstance(acts, (tuple, list))
+                              else [acts])]
+            if acts and (any(v not in ("Relu", "Tanh") for v in acts)
+                         or len(set(acts)) > 1):
+                # our rnn op applies ONE activation to every direction
+                raise MXNetError(
+                    f"ONNX import: RNN activations {acts} unsupported "
+                    "(must be uniform Relu or Tanh)")
+            mode = "rnn_relu" if "Relu" in acts else "rnn_tanh"
+            ngates = 1
+
+            def unperm(arr):
+                return onp.asarray(arr, "float32")
+
         W = const_of(n["inputs"][1])
         R = const_of(n["inputs"][2])
         B = const_of(n["inputs"][3]) if len(n["inputs"]) > 3 and \
             n["inputs"][3] else None
         if W is None or R is None:
-            raise MXNetError("ONNX import: LSTM weights must be "
+            raise MXNetError(f"ONNX import: {op} weights must be "
                              "initializers")
-        if len(n["inputs"]) < 7 or not n["inputs"][5] or \
-                not n["inputs"][6]:
-            raise MXNetError("ONNX import: LSTM requires initial_h and "
-                             "initial_c inputs (exported graphs carry "
-                             "them)")
-        h0, c0 = env[n["inputs"][5]], env[n["inputs"][6]]
+        if len(n["inputs"]) < 6 or not n["inputs"][5] or \
+                (is_lstm and (len(n["inputs"]) < 7 or not n["inputs"][6])):
+            raise MXNetError(f"ONNX import: {op} requires initial state "
+                             "inputs (exported graphs carry them)")
+        h0 = env[n["inputs"][5]]
+        c0 = env[n["inputs"][6]] if is_lstm else None
 
-        def unperm(arr):          # rows iofc -> our ifgo
-            i, o, f, c = onp.split(onp.asarray(arr, "float32"), 4)
-            return onp.concatenate([i, f, c, o])
+        from ...symbol.symbol import SymNode
 
         weight_syms = []
         for d in range(nd):
             w_ih = unperm(W[d])
             w_hh = unperm(R[d])
+            gh = ngates * H
             if B is not None:
-                b_ih = unperm(B[d][:4 * H])
-                b_hh = unperm(B[d][4 * H:])
+                b_ih = unperm(B[d][:gh])
+                b_hh = unperm(B[d][gh:])
             else:
-                b_ih = onp.zeros(4 * H, "float32")
-                b_hh = onp.zeros(4 * H, "float32")
+                b_ih = onp.zeros(gh, "float32")
+                b_hh = onp.zeros(gh, "float32")
             for arr, hint in ((w_ih, "w_ih"), (w_hh, "w_hh"),
                               (b_ih, "b_ih"), (b_hh, "b_hh")):
-                nm = f"{n['name'] or 'lstm'}_{hint}_d{d}_{len(params)}"
+                nm = f"{n['name'] or op.lower()}_{hint}_d{d}_{len(params)}"
                 params[nm] = arr
-                from ...symbol.symbol import SymNode
-
                 env[nm] = Symbol([(SymNode(name=nm), 0)])
                 weight_syms.append(env[nm])
-        out = Symbol.apply_op("rnn", ins[0], h0, c0, *weight_syms,
-                              mode="lstm", num_layers=1, hidden_size=H,
-                              bidirectional=False, nout=3)
-        # ONNX Y is (T, num_dirs=1, B, H); ours is (T, B, H)
-        y = Symbol.apply_op("expand_dims", out[0], axis=1)
-        return [y, out[1], out[2]]
+        state_args = [h0, c0] if is_lstm else [h0]
+        out = Symbol.apply_op("rnn", ins[0], *state_args, *weight_syms,
+                              mode=mode, num_layers=1, hidden_size=H,
+                              bidirectional=nd == 2,
+                              nout=3 if is_lstm else 2)
+        # ONNX Y is (T, nd, B, H); ours is (T, B, nd*H)
+        if nd == 1:
+            y = Symbol.apply_op("expand_dims", out[0], axis=1)
+        else:
+            halves = Symbol.apply_op("split", out[0],
+                                     indices_or_sections=2, axis=-1,
+                                     nout=2)
+            y = Symbol.apply_op("stack", halves[0], halves[1], axis=1)
+        outs_list = [y, out[1]]
+        if is_lstm:
+            outs_list.append(out[2])
+        return outs_list
     raise MXNetError(f"ONNX import: op {op!r} unsupported")
 
 

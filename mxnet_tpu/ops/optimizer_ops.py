@@ -275,6 +275,33 @@ def _sparse_adagrad_update(lr=0.01, epsilon=1e-7, wd=0.0, rescale_grad=1.0,
     return f
 
 
+@register("sparse_adam_update", nout=3)
+def _sparse_adam_update(lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                        wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                        t=1.0):
+    """Lazy row-sparse Adam (reference: adam_update FComputeEx with
+    lazy_update=1, optimizer_op.cc AdamLazyUpdate): mean/var/weight move
+    ONLY on the gradient's active rows; bias correction uses the global
+    step count, matching the reference's lazy semantics (inactive rows'
+    moments do not decay)."""
+    def f(weight, mean, var, grad_rows, indices):
+        idx = indices.astype(jnp.int32)
+        g = grad_rows * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        w_rows = weight[idx]
+        g = g + wd * w_rows
+        m_rows = beta1 * mean[idx] + (1 - beta1) * g
+        v_rows = beta2 * var[idx] + (1 - beta2) * g * g
+        mhat = m_rows / (1 - beta1 ** t)
+        vhat = v_rows / (1 - beta2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + epsilon)
+        return (weight.at[idx].set(w_rows - upd),
+                mean.at[idx].set(m_rows), var.at[idx].set(v_rows))
+
+    return f
+
+
 @register("group_adagrad_update", nout=2)
 def _group_adagrad_update(lr=0.01, epsilon=1e-5, rescale_grad=1.0,
                           clip_gradient=-1.0):

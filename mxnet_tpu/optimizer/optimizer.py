@@ -280,10 +280,13 @@ class NAG(Optimizer):
 
 class _AdamBase(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, correct_bias=True, adamw=False, **kwargs):
+                 epsilon=1e-8, correct_bias=True, adamw=False,
+                 lazy_update=True, **kwargs):
         super().__init__(learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.correct_bias = correct_bias
+        self.lazy_update = lazy_update
+        self._decoupled_wd = adamw
         b1, b2, eps = beta1, beta2, epsilon
         decoupled = adamw
 
@@ -318,6 +321,28 @@ class _AdamBase(Optimizer):
         w._set_data(new_w)
         state["mean"]._set_data(m)
         state["var"]._set_data(v)
+
+    def _apply_sparse(self, weight, grad, state, lr, wd, t):
+        """Lazy row-sparse Adam (reference: adam_update lazy_update=1):
+        moments and weight move only on active rows. Decoupled weight
+        decay (AdamW) touches every row by definition — dense fallback."""
+        if self._decoupled_wd or not self.lazy_update \
+                or not self.correct_bias:
+            return False
+        from ..ops.registry import get_op
+
+        fn = get_op("sparse_adam_update").fn(
+            lr=float(lr), beta1=self.beta1, beta2=self.beta2,
+            epsilon=self.epsilon, wd=float(wd),
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient or -1.0, t=float(t))
+        new_w, m, v = fn(weight._data, state["mean"]._data,
+                         state["var"]._data, grad.data._data,
+                         grad.indices._data)
+        weight._set_data(new_w)
+        state["mean"]._set_data(m)
+        state["var"]._set_data(v)
+        return True
 
 
 @register

@@ -781,3 +781,82 @@ def test_onnx_slice_key_negative_step_and_mixed(tmp_path):
                                onnx_file_path=str(tmp_path / "sl.onnx"))
     blk = mxonnx.import_to_gluon(path)
     assert_almost_equal(blk(x).asnumpy(), ref, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("kind", ["gru", "rnn_relu", "rnn_tanh", "bilstm"])
+def test_onnx_rnn_family_roundtrip(kind, tmp_path):
+    """GRU (linear_before_reset=1 form, zrh<->rzn gate reorder), vanilla
+    RNN (relu/tanh activations), and bidirectional LSTM all round-trip
+    through their native ONNX nodes."""
+    from mxnet_tpu.contrib import onnx as mxonnx
+    from mxnet_tpu.gluon import nn, rnn
+
+    class Seq(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(40, 6)
+            if kind == "gru":
+                self.rec = rnn.GRU(5, num_layers=2, layout="NTC")
+            elif kind == "bilstm":
+                self.rec = rnn.LSTM(5, num_layers=1, layout="NTC",
+                                    bidirectional=True)
+            else:
+                self.rec = rnn.RNN(5, num_layers=1, layout="NTC",
+                                   activation=kind.split("_")[1])
+            self.out = nn.Dense(3, flatten=False,
+                                in_units=10 if kind == "bilstm" else 5)
+
+        def forward(self, x):
+            return self.out(self.rec(self.emb(x)))
+
+    net = Seq()
+    _roundtrip_block(net, (2, 7), tmp_path, dtype="int32", atol=1e-5)
+
+
+def test_onnx_gqa_attention_and_gather_indexing(tmp_path):
+    """Grouped-query attention exports via an Expand-based kv-head repeat,
+    and single-array advanced indexing exports as Gather."""
+    from mxnet_tpu.cached_op import trace
+    from mxnet_tpu.contrib import onnx as mxonnx
+    from mxnet_tpu import npx
+
+    rs = onp.random.RandomState(5)
+    B, T, E, H = 2, 6, 16, 4
+    q = np.array(rs.randn(B, T, E).astype("float32"))
+    kv = np.array(rs.randn(B, T, E // 2).astype("float32"))
+
+    def f(a, b):
+        att = npx.multihead_attention(a, b, b, num_heads=H, num_kv_heads=2)
+        return att[:, np.array([0, 2, 5])]  # Gather on axis 1
+
+    with mx.autograd.predict_mode():
+        ref = f(q, kv).asnumpy()
+    _, _, cop = trace(f, [q, kv], [])
+    path = mxonnx.export_model(
+        cop.sym, params={}, input_shape={"data0": (B, T, E),
+                                         "data1": (B, T, E // 2)},
+        onnx_file_path=str(tmp_path / "gqa.onnx"))
+    blk = mxonnx.import_to_gluon(path)
+    got = blk(q, kv).asnumpy()
+    assert got.shape == (B, 3, E)
+    assert_almost_equal(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_onnx_gather_negative_indices_roundtrip(tmp_path):
+    """Negative index arrays survive the Gather round trip (ONNX wraps
+    idx+dim; a clip-mode import would silently send -1 to row 0)."""
+    from mxnet_tpu.cached_op import trace
+    from mxnet_tpu.contrib import onnx as mxonnx
+
+    x = np.array(onp.arange(18, dtype="float32").reshape(6, 3))
+
+    def f(a):
+        return a[np.array([-1, 0, -2])]
+
+    ref = f(x).asnumpy()
+    _, _, cop = trace(f, [x], [])
+    path = mxonnx.export_model(cop.sym, params={},
+                               input_shape={"data0": (6, 3)},
+                               onnx_file_path=str(tmp_path / "ng.onnx"))
+    blk = mxonnx.import_to_gluon(path)
+    assert_almost_equal(blk(x).asnumpy(), ref, rtol=1e-6, atol=1e-6)

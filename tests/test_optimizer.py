@@ -286,3 +286,43 @@ def test_group_adagrad():
     w_want = 1.0 - 0.1 * gdata / (onp.sqrt(h_want) + 1e-6)
     assert_almost_equal(w2n[[1, 4]], w_want, rtol=1e-6, atol=1e-7)
     assert_almost_equal(hist2[[1, 4]], h_want, rtol=1e-6, atol=1e-7)
+
+
+def test_adam_lazy_sparse_update():
+    """Lazy row-sparse Adam (reference: adam_update lazy_update=1 /
+    AdamLazyUpdate): moments and weight move only on active rows, exact
+    per-row recurrence, and AdamW falls back to the dense path (decoupled
+    decay touches every row)."""
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    opt = optimizer.create("adam", learning_rate=0.1)
+    w = np.array(onp.ones((6, 3), "float32"))
+    st = opt.create_state(0, w)
+    gdata = onp.full((2, 3), 0.5, "float32")
+    rows = onp.array([1, 4], "int32")
+    rs = RowSparseNDArray(NDArray(gdata), NDArray(rows), (6, 3))
+    opt.update(0, w, rs, st)
+    opt.update(0, w, rs, st)  # second step: bias correction uses t=2
+    wn = w.asnumpy()
+    assert (wn[0] == 1).all() and (wn[5] == 1).all()
+    assert (st["mean"].asnumpy()[0] == 0).all()
+    # exact reference recurrence on the touched rows
+    m = v = onp.zeros_like(gdata)
+    want = onp.ones_like(gdata)
+    for t in (1, 2):
+        m = 0.9 * m + 0.1 * gdata
+        v = 0.999 * v + 0.001 * gdata * gdata
+        mhat = m / (1 - 0.9 ** t)
+        vhat = v / (1 - 0.999 ** t)
+        want = want - 0.1 * mhat / (onp.sqrt(vhat) + 1e-8)
+    assert_almost_equal(wn[rows], want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(st["mean"].asnumpy()[rows], m, rtol=1e-5,
+                        atol=1e-6)
+    # adamw densifies (all rows decay under decoupled wd)
+    opt2 = optimizer.create("adamw", learning_rate=0.1, wd=0.1)
+    w2 = np.array(onp.ones((6, 3), "float32"))
+    st2 = opt2.create_state(0, w2)
+    opt2.update(0, w2, RowSparseNDArray(NDArray(gdata), NDArray(rows),
+                                        (6, 3)), st2)
+    assert (w2.asnumpy()[0] < 1).all()  # untouched row decayed -> dense
