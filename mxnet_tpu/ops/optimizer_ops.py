@@ -302,6 +302,33 @@ def _sparse_adam_update(lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
     return f
 
 
+@register("sparse_ftrl_update", nout=3)
+def _sparse_ftrl_update(lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """Row-sparse FTRL (reference: ftrl_update FComputeEx,
+    MXNET_ADD_SPARSE_OP_ALIAS optimizer_op.cc:848): z/n/weight update only
+    the gradient's active rows."""
+    def f(weight, z, n, grad_rows, indices):
+        idx = indices.astype(jnp.int32)
+        g = grad_rows * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        w_rows = weight[idx]
+        n_rows = n[idx]
+        sigma = (jnp.sqrt(n_rows + g * g) - jnp.sqrt(n_rows)) / lr
+        z_rows = z[idx] + g - sigma * w_rows
+        n_rows = n_rows + g * g
+        new_w_rows = jnp.where(
+            jnp.abs(z_rows) > lamda1,
+            -(z_rows - jnp.sign(z_rows) * lamda1) /
+            ((beta + jnp.sqrt(n_rows)) / lr + wd),
+            0.0)
+        return (weight.at[idx].set(new_w_rows), z.at[idx].set(z_rows),
+                n.at[idx].set(n_rows))
+
+    return f
+
+
 @register("group_adagrad_update", nout=2)
 def _group_adagrad_update(lr=0.01, epsilon=1e-5, rescale_grad=1.0,
                           clip_gradient=-1.0):

@@ -172,7 +172,10 @@ class Optimizer:
     # common grad preprocessing, traced into each jitted step (rescale is
     # handled eagerly in _update_one; only the static clip bound bakes in)
     def _pre(self, g, w=None, wd=None):
-        if self.clip_gradient is not None:
+        # reference semantics (optimizer_op.cc docs): clip_gradient <= 0
+        # turns clipping OFF — keeps dense and lazy-sparse paths identical
+        # for every value of the knob
+        if self.clip_gradient is not None and self.clip_gradient > 0:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return g
 
@@ -248,7 +251,8 @@ class SGD(Optimizer):
 
         fn = get_op("sparse_sgd_update").fn(
             lr=float(lr), wd=float(wd), rescale_grad=self.rescale_grad,
-            clip_gradient=self.clip_gradient or -1.0)
+            clip_gradient=-1.0 if self.clip_gradient is None
+            else self.clip_gradient)
         weight._set_data(fn(weight._data, grad.data._data,
                             grad.indices._data))
         return True
@@ -335,7 +339,8 @@ class _AdamBase(Optimizer):
             lr=float(lr), beta1=self.beta1, beta2=self.beta2,
             epsilon=self.epsilon, wd=float(wd),
             rescale_grad=self.rescale_grad,
-            clip_gradient=self.clip_gradient or -1.0, t=float(t))
+            clip_gradient=-1.0 if self.clip_gradient is None
+            else self.clip_gradient, t=float(t))
         new_w, m, v = fn(weight._data, state["mean"]._data,
                          state["var"]._data, grad.data._data,
                          grad.indices._data)
@@ -478,7 +483,8 @@ class AdaGrad(Optimizer):
         fn = get_op("sparse_adagrad_update").fn(
             lr=float(lr), epsilon=self._eps, wd=float(wd),
             rescale_grad=self.rescale_grad,
-            clip_gradient=self.clip_gradient or -1.0)
+            clip_gradient=-1.0 if self.clip_gradient is None
+            else self.clip_gradient)
         new_w, new_h = fn(weight._data, state["history"]._data,
                           grad.data._data, grad.indices._data)
         weight._set_data(new_w)
@@ -516,6 +522,7 @@ class AdaDelta(Optimizer):
 class Ftrl(Optimizer):
     def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
         super().__init__(learning_rate, **kwargs)
+        self._lamda1, self._beta = lamda1, beta
 
         def step(w, z, n, g, lr, wd):
             g = self._pre(g)
@@ -541,6 +548,22 @@ class Ftrl(Optimizer):
         w._set_data(new_w)
         state["z"]._set_data(z)
         state["n"]._set_data(n)
+
+    def _apply_sparse(self, weight, grad, state, lr, wd, t):
+        """Lazy row-sparse FTRL (reference: ftrl_update sparse alias)."""
+        from ..ops.registry import get_op
+
+        fn = get_op("sparse_ftrl_update").fn(
+            lr=float(lr), lamda1=self._lamda1, beta=self._beta,
+            wd=float(wd), rescale_grad=self.rescale_grad,
+            clip_gradient=-1.0 if self.clip_gradient is None
+            else self.clip_gradient)
+        new_w, z, n = fn(weight._data, state["z"]._data, state["n"]._data,
+                         grad.data._data, grad.indices._data)
+        weight._set_data(new_w)
+        state["z"]._set_data(z)
+        state["n"]._set_data(n)
+        return True
 
 
 @register

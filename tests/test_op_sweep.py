@@ -557,6 +557,9 @@ SPECS.update({
     "sparse_adam_update": (
         lambda: [_f(6, 3), _f(6, 3), onp.abs(_f(6, 3)), _f(2, 3),
                  onp.array([1, 4])], {"lr": 0.1, "t": 2.0}, None, False),
+    "sparse_ftrl_update": (
+        lambda: [_f(6, 3), _f(6, 3), onp.abs(_f(6, 3)), _f(2, 3),
+                 onp.array([1, 4])], {"lr": 0.1}, None, False),
     "group_adagrad_update": (lambda: [_f(4, 3), onp.abs(_f(4)), _f(4, 3)],
                              {"lr": 0.1}, None, False),
     # interleaved reference convention: (w0, g0, w1, g1, ...)

@@ -326,3 +326,29 @@ def test_adam_lazy_sparse_update():
     opt2.update(0, w2, RowSparseNDArray(NDArray(gdata), NDArray(rows),
                                         (6, 3)), st2)
     assert (w2.asnumpy()[0] < 1).all()  # untouched row decayed -> dense
+
+
+def test_ftrl_lazy_sparse_matches_dense_rows():
+    """Lazy row-sparse FTRL (reference: ftrl_update sparse alias): active
+    rows match the dense recurrence exactly; untouched rows bit-equal."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    opt_s = optimizer.create("ftrl", learning_rate=0.5)
+    opt_d = optimizer.create("ftrl", learning_rate=0.5)
+    rs = onp.random.RandomState(2)
+    w0 = rs.randn(6, 3).astype("float32")
+    gdata = rs.randn(2, 3).astype("float32")
+    rows = onp.array([1, 4], "int32")
+    ws = np.array(w0.copy())
+    ss = opt_s.create_state(0, ws)
+    opt_s.update(0, ws, RowSparseNDArray(NDArray(gdata), NDArray(rows),
+                                         (6, 3)), ss)
+    # dense twin sees the densified gradient
+    wd = np.array(w0.copy())
+    sd = opt_d.create_state(0, wd)
+    gd = onp.zeros((6, 3), "float32")
+    gd[rows] = gdata
+    opt_d.update(0, wd, np.array(gd), sd)
+    wsn, wdn = ws.asnumpy(), wd.asnumpy()
+    assert_almost_equal(wsn[rows], wdn[rows], rtol=1e-6, atol=1e-7)
+    assert (wsn[[0, 2, 3, 5]] == w0[[0, 2, 3, 5]]).all()

@@ -154,7 +154,7 @@ def stage_consistency():
 def stage_opperf():
     rc, js, tail = _run(
         [PY, "benchmark/opperf.py", "--out",
-         os.path.join(OUT, "opperf_tpu.json")], timeout=1800)
+         os.path.join(OUT, "opperf_ondevice.json")], timeout=1800)
     print(f"[opperf] rc={rc} {tail[-200:]}", flush=True)
     return {"rc": rc}
 
