@@ -17,9 +17,12 @@ arithmetic, dropout (exported as Identity). This closes the model zoo:
 every registered vision model, the word-LM LSTM, the GRU/RNN/bi-LSTM
 family and BERT round-trip numerically (tests/test_contrib.py
 representatives; tests/nightly/test_onnx_full_zoo.py sweeps all).
-Grouped-query attention exports via an Expand-based kv-head repeat, and
-single-array advanced indexing maps to Gather. Known gaps: multi-array /
-mixed advanced indexing, GRU-with-linear_before_reset=0 import. Ops outside the set raise MXNetError
+Grouped-query attention exports via an Expand-based kv-head repeat;
+single-array advanced indexing maps to Gather and pure multi-array
+indexing to GatherND. Known gaps: mixed basic+advanced indexing, and
+GRU-with-linear_before_reset=0 import (a genuinely different recurrence —
+the reset gate multiplies the hidden state before the recurrent matmul,
+which no weight transform can emulate). Ops outside the set raise MXNetError
 naming the op. If a real ``onnx`` package is present it is NOT required —
 files round-trip through this codec (and a skipped-unless-available test
 validates through the real checker/runtime when the package exists).

@@ -259,6 +259,34 @@ class _Exporter:
             _attr_f("epsilon", float(a.get("eps", 1e-5)))
         self.nodes.append(_node("LayerNormalization", ins, outs, attrs))
 
+    def _reduce(self, onnx_op, a, ins, outs, axes_as_input):
+        """Reductions. Opset 13: ReduceSum takes axes as an INPUT;
+        ReduceMean/Max/Min still take the axes ATTRIBUTE."""
+        axis = a.get("axis")
+        axes = None if axis is None else \
+            [axis] if isinstance(axis, int) else list(axis)
+        keep = _attr_i("keepdims", 1 if a.get("keepdims") else 0)
+        if axes_as_input:
+            node_ins = [ins[0]] + ([self.ints_const(axes, "axes")]
+                                   if axes is not None else [])
+            self.nodes.append(_node(onnx_op, node_ins, outs, keep))
+        else:
+            attrs = keep + (_attr_ints("axes", axes)
+                            if axes is not None else b"")
+            self.nodes.append(_node(onnx_op, ins[:1], outs, attrs))
+
+    def cv_sum(self, a, ins, outs):
+        self._reduce("ReduceSum", a, ins, outs, axes_as_input=True)
+
+    def cv_mean(self, a, ins, outs):
+        self._reduce("ReduceMean", a, ins, outs, axes_as_input=False)
+
+    def cv_max(self, a, ins, outs):
+        self._reduce("ReduceMax", a, ins, outs, axes_as_input=False)
+
+    def cv_min(self, a, ins, outs):
+        self._reduce("ReduceMin", a, ins, outs, axes_as_input=False)
+
     def cv_swapaxes(self, a, ins, outs):
         ndim = len(self.shape_of(ins[0]))
         ax1 = a.get("axis1", 0) % ndim
@@ -270,10 +298,10 @@ class _Exporter:
 
     def cv_slice_key(self, a, ins, outs):
         """Static basic indexing (ints/slices/ellipsis/None) as ONNX
-        Slice + Squeeze + Unsqueeze. The embedding-style advanced case —
-        exactly ONE index array, every other entry a full slice — maps to
-        Gather on that axis; mixed/multi-array advanced indexing has no
-        clean ONNX mapping and raises."""
+        Slice + Squeeze + Unsqueeze. Advanced cases: exactly ONE index
+        array with full slices elsewhere maps to Gather on that axis;
+        PURE multi-array indexing (x[a1, a2, ...]) maps to GatherND;
+        mixed basic+advanced indexing raises."""
         spec = a.get("spec", ())
         if len(ins) > 1:
             arr_positions = [i for i, s in enumerate(spec) if s[0] == "a"]
@@ -281,20 +309,49 @@ class _Exporter:
                 s[0] == "e" or (s[0] == "s" and s[1] is None and
                                 s[2] is None and s[3] in (None, 1))
                 for s in spec if s[0] != "a")
-            if len(ins) != 2 or len(arr_positions) != 1 or not others_full:
-                raise MXNetError(
-                    "ONNX export: only single-array advanced indexing "
-                    "(x[..., idx, ...] with full slices elsewhere) maps "
-                    "to Gather; rewrite other patterns with take/gather")
-            before = spec[:arr_positions[0]]
-            axis = sum(1 for s in before if s[0] == "s")
-            if any(s[0] == "e" for s in before):
-                rank = len(self.shape_of(ins[0]))
-                n_real = sum(1 for s in spec if s[0] in ("s", "i", "a"))
-                axis += rank - n_real
-            self.nodes.append(_node("Gather", [ins[0], ins[1]], outs,
-                                    _attr_i("axis", axis)))
-            return
+            if len(ins) == 2 and len(arr_positions) == 1 and others_full:
+                # x[..., idx, ...] with full slices elsewhere -> Gather
+                before = spec[:arr_positions[0]]
+                axis = sum(1 for s in before if s[0] == "s")
+                if any(s[0] == "e" for s in before):
+                    rank = len(self.shape_of(ins[0]))
+                    n_real = sum(1 for s in spec if s[0] in ("s", "i", "a"))
+                    axis += rank - n_real
+                self.nodes.append(_node("Gather", [ins[0], ins[1]], outs,
+                                        _attr_i("axis", axis)))
+                return
+            if len(arr_positions) == len(spec) and \
+                    len(ins) == len(spec) + 1:
+                # x[a1, a2, ...]: pure multi-array indexing -> GatherND.
+                # numpy broadcasts index arrays; GatherND wants one stacked
+                # indices tensor, so require equal shapes (the common case)
+                shapes = [self.shape_of(i) for i in ins[1:]]
+                if len(set(shapes)) != 1:
+                    raise MXNetError(
+                        "ONNX export: multi-array indexing needs equal "
+                        f"index shapes for GatherND, got {shapes}")
+                cols = []
+                ax = self.ints_const([-1], "axes")
+                for idx_in in ins[1:]:
+                    u = self.fresh("un")
+                    self.nodes.append(_node("Unsqueeze", [idx_in, ax],
+                                            [u]))
+                    cols.append(u)
+                stacked = self.fresh("ix")
+                self.nodes.append(_node("Concat", cols, [stacked],
+                                        _attr_i("axis", -1)))
+                # spec: GatherND indices must be int64 (Gather also allows
+                # int32, GatherND does not) — traced constants are int32
+                idx64 = self.fresh("ix64")
+                self.nodes.append(_node("Cast", [stacked], [idx64],
+                                        _attr_i("to", 7)))  # INT64
+                self.nodes.append(_node("GatherND", [ins[0], idx64],
+                                        outs))
+                return
+            raise MXNetError(
+                "ONNX export: only single-array (-> Gather) or pure "
+                "multi-array (-> GatherND) advanced indexing is mapped; "
+                "rewrite mixed patterns with take/gather")
         shape = self.shape_of(ins[0])
         rank = len(shape)
         n_real = sum(1 for s in spec if s[0] in ("s", "i"))

@@ -216,6 +216,36 @@ def _convert_node(n, env, params):
             return Symbol.apply_op("embedding", ins[1], ins[0])
         return Symbol.apply_op("take", ins[0], ins[1],
                                axis=int(a.get("axis", 0)), mode="wrap")
+    if op in ("ReduceSum", "ReduceMean", "ReduceMax", "ReduceMin"):
+        name = {"ReduceSum": "sum", "ReduceMean": "mean",
+                "ReduceMax": "max", "ReduceMin": "min"}[op]
+        if len(n["inputs"]) > 1 and n["inputs"][1]:
+            if op != "ReduceSum":
+                # axes-as-input for Mean/Max/Min is opset>=18; this codec
+                # targets 13 — fail loudly, never silently reduce-all
+                raise MXNetError(
+                    f"ONNX import: {op} with axes as an input (opset>=18) "
+                    "unsupported; re-export at opset 13")
+            axes = const_of(n["inputs"][1])       # opset 13: axes input
+            if axes is None:
+                raise MXNetError("ONNX import: dynamic ReduceSum axes "
+                                 "unsupported")
+            axis = tuple(int(v) for v in axes)
+        else:
+            raw = a.get("axes")
+            axis = None if raw is None else tuple(int(v) for v in raw)
+        if axis is None and int(a.get("noop_with_empty_axes", 0)):
+            return _apply("copy", ins)  # spec: empty axes + noop -> identity
+        return Symbol.apply_op(name, ins[0], axis=axis,
+                               keepdims=bool(a.get("keepdims", 1)))
+    if op == "GatherND":
+        if int(a.get("batch_dims", 0)):
+            raise MXNetError("ONNX import: GatherND batch_dims != 0 "
+                             "unsupported")
+        # ONNX stacks the index tuple on the LAST axis; our gather_nd op
+        # (mxnet convention) wants it on the FIRST
+        idx = Symbol.apply_op("moveaxis", ins[1], source=-1, destination=0)
+        return Symbol.apply_op("gather_nd", ins[0], idx)
     if op == "Expand":
         shape = const_of(n["inputs"][1])
         if shape is None:

@@ -169,6 +169,11 @@ class Optimizer:
         handled (caller densifies)."""
         return False
 
+    def _clip_arg(self):
+        """clip_gradient for the sparse update kernels: -1.0 disables
+        (kernels follow the reference's clip<=0-means-off contract)."""
+        return -1.0 if self.clip_gradient is None else self.clip_gradient
+
     # common grad preprocessing, traced into each jitted step (rescale is
     # handled eagerly in _update_one; only the static clip bound bakes in)
     def _pre(self, g, w=None, wd=None):
@@ -251,8 +256,7 @@ class SGD(Optimizer):
 
         fn = get_op("sparse_sgd_update").fn(
             lr=float(lr), wd=float(wd), rescale_grad=self.rescale_grad,
-            clip_gradient=-1.0 if self.clip_gradient is None
-            else self.clip_gradient)
+            clip_gradient=self._clip_arg())
         weight._set_data(fn(weight._data, grad.data._data,
                             grad.indices._data))
         return True
@@ -339,8 +343,7 @@ class _AdamBase(Optimizer):
             lr=float(lr), beta1=self.beta1, beta2=self.beta2,
             epsilon=self.epsilon, wd=float(wd),
             rescale_grad=self.rescale_grad,
-            clip_gradient=-1.0 if self.clip_gradient is None
-            else self.clip_gradient, t=float(t))
+            clip_gradient=self._clip_arg(), t=float(t))
         new_w, m, v = fn(weight._data, state["mean"]._data,
                          state["var"]._data, grad.data._data,
                          grad.indices._data)
@@ -483,8 +486,7 @@ class AdaGrad(Optimizer):
         fn = get_op("sparse_adagrad_update").fn(
             lr=float(lr), epsilon=self._eps, wd=float(wd),
             rescale_grad=self.rescale_grad,
-            clip_gradient=-1.0 if self.clip_gradient is None
-            else self.clip_gradient)
+            clip_gradient=self._clip_arg())
         new_w, new_h = fn(weight._data, state["history"]._data,
                           grad.data._data, grad.indices._data)
         weight._set_data(new_w)
@@ -556,8 +558,7 @@ class Ftrl(Optimizer):
         fn = get_op("sparse_ftrl_update").fn(
             lr=float(lr), lamda1=self._lamda1, beta=self._beta,
             wd=float(wd), rescale_grad=self.rescale_grad,
-            clip_gradient=-1.0 if self.clip_gradient is None
-            else self.clip_gradient)
+            clip_gradient=self._clip_arg())
         new_w, z, n = fn(weight._data, state["z"]._data, state["n"]._data,
                          grad.data._data, grad.indices._data)
         weight._set_data(new_w)

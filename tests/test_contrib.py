@@ -860,3 +860,48 @@ def test_onnx_gather_negative_indices_roundtrip(tmp_path):
                                onnx_file_path=str(tmp_path / "ng.onnx"))
     blk = mxonnx.import_to_gluon(path)
     assert_almost_equal(blk(x).asnumpy(), ref, rtol=1e-6, atol=1e-6)
+
+
+def test_onnx_multi_array_indexing_gathernd(tmp_path):
+    """Pure multi-array advanced indexing (x[a1, a2]) exports as GatherND
+    with the index tuple stacked on the trailing axis, re-imports through
+    our leading-axis gather_nd, and matches numpy fancy indexing."""
+    from mxnet_tpu.cached_op import trace
+    from mxnet_tpu.contrib import onnx as mxonnx
+
+    x = np.array(onp.arange(60, dtype="float32").reshape(4, 5, 3))
+
+    def f(a):
+        return a[np.array([0, 3, 2]), np.array([1, 4, 0])]
+
+    ref = f(x).asnumpy()
+    assert ref.shape == (3, 3)
+    _, _, cop = trace(f, [x], [])
+    path = mxonnx.export_model(cop.sym, params={},
+                               input_shape={"data0": (4, 5, 3)},
+                               onnx_file_path=str(tmp_path / "gn.onnx"))
+    blk = mxonnx.import_to_gluon(path)
+    assert_almost_equal(blk(x).asnumpy(), ref, rtol=1e-6, atol=1e-6)
+
+
+def test_onnx_reductions_roundtrip(tmp_path):
+    """sum/mean/max/min reductions round-trip (opset-13 split: ReduceSum
+    takes axes as an input, the others as an attribute)."""
+    from mxnet_tpu.cached_op import trace
+    from mxnet_tpu.contrib import onnx as mxonnx
+
+    x = np.array(onp.random.RandomState(4).randn(3, 4, 5)
+                 .astype("float32"))
+
+    def f(a):
+        return (a.sum(axis=-1), a.mean(axis=(0, 2), keepdims=True),
+                a.max(axis=1), a.min())
+
+    refs = [t.asnumpy() for t in f(x)]
+    _, _, cop = trace(f, [x], [])
+    path = mxonnx.export_model(cop.sym, params={},
+                               input_shape={"data0": (3, 4, 5)},
+                               onnx_file_path=str(tmp_path / "red.onnx"))
+    blk = mxonnx.import_to_gluon(path)
+    for got, ref in zip(blk(x), refs):
+        assert_almost_equal(got.asnumpy(), ref, rtol=1e-5, atol=1e-5)
