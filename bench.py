@@ -4,7 +4,7 @@ Default metric mirrors the reference's headline benchmark
 (example/image-classification/benchmark_score.py; docs/.../faq/perf.md —
 V100 fp16 ResNet-50 batch 128: 2355.04 img/s, BASELINE.md). Select with
 argv[1] or BENCH env: resnet (default) | resnet_train | lstm_lm |
-bert_pretrain | bert_large_pretrain.
+bert_pretrain | bert_large_pretrain | optimizer_step.
 
 Robustness contract (round-1 postmortem): any failure — backend init,
 compile, OOM — still emits a parseable JSON line with an "error" field and
@@ -231,6 +231,76 @@ def bench_bert_pretrain(size="base"):
             "mfu": _mfu(tok_s * 6 * BERT_PARAMS[size])}
 
 
+def bench_optimizer_step():
+    """Fused vs per-param optimizer step over a ResNet-50-sized synthetic
+    parameter set (~160 tensors, ~25M params): Trainer.update with the
+    fused multi-tensor path on vs off. Reports updates/sec both ways and
+    per-step compiled-call counts (fused: O(#buckets); per-param:
+    O(#params))."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu import gluon, optimizer
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    # ResNet-50-shaped tensor set: stem conv + BN pair, 16 bottleneck
+    # blocks (3 conv kernels + 3 BN gamma/beta pairs each), a downsample
+    # conv + BN pair per stage, and the fc head — 163 tensors, ~25M params
+    shapes = [(64, 3, 7, 7), (64,), (64,)]
+    for blocks, cin, cmid in [(3, 256, 64), (4, 512, 128),
+                              (6, 1024, 256), (3, 2048, 512)]:
+        shapes += [(cin, cin // 2 if cin > 256 else 64, 1, 1), (cin,),
+                   (cin,)]  # stage downsample projection
+        for _ in range(blocks):
+            shapes += [(cmid, cin, 1, 1), (cmid,), (cmid,),
+                       (cmid, cmid, 3, 3), (cmid,), (cmid,),
+                       (cin, cmid, 1, 1), (cin,), (cin,)]
+    shapes += [(1000, 2048), (1000,)]
+    rng = onp.random.RandomState(0)
+
+    def build():
+        params = []
+        for j, shp in enumerate(shapes):
+            p = Parameter(name=f"p{j}", shape=shp)
+            p.initialize()
+            p.set_data(jnp.asarray(rng.standard_normal(shp), jnp.float32))
+            p.grad()._set_data(
+                jnp.asarray(rng.standard_normal(shp), jnp.float32))
+            params.append(p)
+        return params
+
+    WARMUP, ITERS = 3, 10
+
+    def run(fuse):
+        import jax
+
+        params = build()
+        tr = gluon.Trainer(params, optimizer.SGD(learning_rate=0.01,
+                                                 momentum=0.9))
+        tr._fuse = fuse
+        for _ in range(WARMUP):
+            tr.update(32)
+        jax.block_until_ready([p.data()._data for p in params])
+        d0 = tr._fused_dispatches
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            tr.update(32)
+        jax.block_until_ready([p.data()._data for p in params])
+        dt = time.perf_counter() - t0
+        dispatch = (tr._fused_dispatches - d0) // ITERS if fuse \
+            else len(params)
+        return len(params) * ITERS / dt, dispatch
+
+    fused_ups, fused_disp = run(True)
+    pp_ups, pp_disp = run(False)
+    return {"metric": "optimizer_step_fused_resnet50_161tensors",
+            "value": round(fused_ups, 1), "unit": "updates/s",
+            "vs_baseline": round(fused_ups / max(pp_ups, 1e-9), 3),
+            "per_param_updates_per_sec": round(pp_ups, 1),
+            "dispatches_fused": fused_disp,
+            "dispatches_per_param": pp_disp,
+            "mfu": None}
+
+
 def _accel_expected():
     """True when this machine is configured for an accelerator, so a CPU
     result must be reported as a failure rather than published silently:
@@ -285,7 +355,8 @@ def main():
               "lstm_lm": bench_lstm_lm,
               "bert_pretrain": bench_bert_pretrain,
               "bert_large_pretrain": functools.partial(bench_bert_pretrain,
-                                                       "large")}[which]
+                                                       "large"),
+              "optimizer_step": bench_optimizer_step}[which]
         # resolve the backend up front through the hardened probe: a hung
         # or dead TPU runtime must not kill the bench (round-1 failure:
         # raw RuntimeError) — and must not silently publish a CPU number

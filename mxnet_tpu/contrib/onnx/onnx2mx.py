@@ -115,6 +115,10 @@ def _convert_node(n, env, params):
               "Max": "maximum", "Min": "minimum", "Identity": "copy"}
     if op in simple:
         return _apply(simple[op], ins)
+    if op == "Cast":
+        return Symbol.apply_op(
+            "astype", ins[0],
+            dtype=P.onnx_to_np_dtype(int(a.get("to", P.FLOAT))))
     if op == "Softplus":
         return Symbol.apply_op("activation", ins[0], act_type="softrelu")
     if op == "Softsign":

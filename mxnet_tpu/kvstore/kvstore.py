@@ -146,10 +146,17 @@ class KVStore(KVStoreBase):
         # row_sparse pushes stay sparse end-to-end in-process: merged rows
         # go straight to the optimizer's lazy _apply_sparse path — the
         # embedding-gradient flow (reference: sparse FComputeEx update
-        # kernels + server-side sparse merge). Multi-worker sparse pushes
-        # densify (cross-host collectives are dense buckets here).
+        # kernels + server-side sparse merge). A value list is sparse only
+        # when ALL its members are row-sparse — mixed dense/sparse lists
+        # densify (the sparse merge cannot sum a dense contribution), as do
+        # multi-worker sparse pushes (cross-host collectives are dense
+        # buckets here).
         sparse = {i for i, v in enumerate(vals)
-                  if any(isinstance(x, RowSparseNDArray)
+                  if all(isinstance(x, RowSparseNDArray)
+                         for x in _as_list(v))}
+        mixed = {i for i, v in enumerate(vals)
+                 if i not in sparse
+                 and any(isinstance(x, RowSparseNDArray)
                          for x in _as_list(v))}
         if sparse and self.num_workers == 1:
             for i in sorted(sparse):
@@ -157,18 +164,22 @@ class KVStore(KVStoreBase):
                 if self._updater is not None and k in self._store:
                     self._updater(k, merged, self._store[k])
                 elif k in self._store:
-                    w = self._store[k]._data
-                    w = w.at[merged.indices._data].set(merged.data._data)
-                    self._store[k]._set_data(w)
+                    # no updater: same replace semantics as a dense push
+                    self._store[k]._set_data(merged.todense()._data)
                 else:
                     self._store[k] = merged.todense()
             keys = [k for i, k in enumerate(keys) if i not in sparse]
             vals = [v for i, v in enumerate(vals) if i not in sparse]
             if not keys:
                 return
+            mixed = {i for i, v in enumerate(vals)
+                     if any(isinstance(x, RowSparseNDArray)
+                            for x in _as_list(v))}
         elif sparse:
+            mixed = mixed | sparse
+        if mixed:
             vals = [[x.todense() if isinstance(x, RowSparseNDArray) else x
-                     for x in _as_list(v)] if i in sparse else v
+                     for x in _as_list(v)] if i in mixed else v
                     for i, v in enumerate(vals)]
         # reduce locally, then across workers in ONE batched collective per
         # dtype bucket (reference: server-side merge of all workers' pushes,

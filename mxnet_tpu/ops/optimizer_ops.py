@@ -237,19 +237,107 @@ def _lamb_phase2(lr=0.001, lower_bound=-1.0, upper_bound=-1.0):
 
 
 # -- sparse (row-sparse gradient) updates — VERDICT missing #8 --------------
+# The `*_core` functions take EVERY hyper-parameter (lr, wd, t, betas,
+# rescale_grad, clip_gradient) as a trailing operand, so one jitted program
+# serves every step of a changing LR schedule (optimizer.py jits them once
+# with donated weight/state buffers). The registered ops below stay
+# attr-parametrized for reference API parity; they close over Python-float
+# attrs, which XLA constant-folds to the same program the static form had.
+
+
+def _rt_clip(g, clip_gradient):
+    """Runtime-operand gradient clip: clip_gradient <= 0 disables, the
+    reference's contract. The clip is computed unconditionally and discarded
+    via where() — branchless, so the bound can change without a retrace."""
+    return jnp.where(clip_gradient > 0,
+                     jnp.clip(g, -jnp.abs(clip_gradient), clip_gradient), g)
+
+
+def sparse_sgd_core(weight, grad_rows, indices, lr, wd, rescale_grad,
+                    clip_gradient):
+    """Row-sparse SGD: only rows named by ``indices`` are touched
+    (reference: sgd_update FComputeEx on kRowSparseStorage)."""
+    idx = indices.astype(jnp.int32)
+    w_rows = weight[idx]
+    g = _rt_clip(grad_rows * rescale_grad, clip_gradient)
+    g = g + wd * w_rows
+    return weight.at[idx].set(w_rows - lr * g)
+
+
+def sparse_adagrad_core(weight, history, grad_rows, indices, lr, wd,
+                        epsilon, rescale_grad, clip_gradient):
+    """Row-sparse AdaGrad (reference: _sparse_adagrad_update,
+    optimizer_op.cc sparse kernels): history and weight update only on the
+    gradient's active rows — the lazy-update semantics embeddings rely on."""
+    idx = indices.astype(jnp.int32)
+    g = _rt_clip(grad_rows * rescale_grad, clip_gradient)
+    g = g + wd * weight[idx]
+    h_rows = history[idx] + g * g
+    new_hist = history.at[idx].set(h_rows)
+    new_w = weight.at[idx].add(-lr * g / (jnp.sqrt(h_rows) + epsilon))
+    return new_w, new_hist
+
+
+def sparse_adam_core(weight, mean, var, grad_rows, indices, lr, wd, t,
+                     beta1, beta2, epsilon, rescale_grad, clip_gradient):
+    """Lazy row-sparse Adam (reference: adam_update FComputeEx with
+    lazy_update=1, optimizer_op.cc AdamLazyUpdate): mean/var/weight move
+    ONLY on the gradient's active rows; bias correction uses the global
+    step count, matching the reference's lazy semantics (inactive rows'
+    moments do not decay)."""
+    idx = indices.astype(jnp.int32)
+    g = _rt_clip(grad_rows * rescale_grad, clip_gradient)
+    w_rows = weight[idx]
+    g = g + wd * w_rows
+    m_rows = beta1 * mean[idx] + (1 - beta1) * g
+    v_rows = beta2 * var[idx] + (1 - beta2) * g * g
+    mhat = m_rows / (1 - beta1 ** t)
+    vhat = v_rows / (1 - beta2 ** t)
+    upd = lr * mhat / (jnp.sqrt(vhat) + epsilon)
+    return (weight.at[idx].set(w_rows - upd),
+            mean.at[idx].set(m_rows), var.at[idx].set(v_rows))
+
+
+def sparse_ftrl_core(weight, z, n, grad_rows, indices, lr, lamda1, beta,
+                     wd, rescale_grad, clip_gradient):
+    """Row-sparse FTRL (reference: ftrl_update FComputeEx,
+    MXNET_ADD_SPARSE_OP_ALIAS optimizer_op.cc:848): z/n/weight update only
+    the gradient's active rows."""
+    idx = indices.astype(jnp.int32)
+    g = _rt_clip(grad_rows * rescale_grad, clip_gradient)
+    w_rows = weight[idx]
+    n_rows = n[idx]
+    sigma = (jnp.sqrt(n_rows + g * g) - jnp.sqrt(n_rows)) / lr
+    z_rows = z[idx] + g - sigma * w_rows
+    n_rows = n_rows + g * g
+    new_w_rows = jnp.where(
+        jnp.abs(z_rows) > lamda1,
+        -(z_rows - jnp.sign(z_rows) * lamda1) /
+        ((beta + jnp.sqrt(n_rows)) / lr + wd),
+        0.0)
+    return (weight.at[idx].set(new_w_rows), z.at[idx].set(z_rows),
+            n.at[idx].set(n_rows))
+
+
+def sparse_group_adagrad_core(weight, history, grad_rows, indices, lr,
+                              epsilon, rescale_grad, clip_gradient):
+    """Row-sparse GroupAdaGrad (reference: contrib
+    _contrib_group_adagrad_update on kRowSparseStorage): one history scalar
+    per row; only the gradient's active rows move."""
+    idx = indices.astype(jnp.int32)
+    g = _rt_clip(grad_rows * rescale_grad, clip_gradient)
+    h_rows = history[idx] + jnp.mean(
+        g * g, axis=tuple(range(1, g.ndim)), keepdims=True)
+    upd = lr * g / (jnp.sqrt(h_rows) + epsilon)
+    return weight.at[idx].add(-upd), history.at[idx].set(h_rows)
+
+
 @register("sparse_sgd_update", nout=1)
 def _sparse_sgd_update(lr=0.01, wd=0.0, rescale_grad=1.0,
                        clip_gradient=-1.0):
-    """Row-sparse SGD: only rows named by ``indices`` are touched
-    (reference: sgd_update FComputeEx on kRowSparseStorage)."""
     def f(weight, grad_rows, indices):
-        idx = indices.astype(jnp.int32)
-        w_rows = weight[idx]
-        g = grad_rows * rescale_grad
-        if clip_gradient > 0:
-            g = jnp.clip(g, -clip_gradient, clip_gradient)
-        g = g + wd * w_rows
-        return weight.at[idx].set(w_rows - lr * g)
+        return sparse_sgd_core(weight, grad_rows, indices, lr, wd,
+                               rescale_grad, clip_gradient)
 
     return f
 
@@ -257,20 +345,10 @@ def _sparse_sgd_update(lr=0.01, wd=0.0, rescale_grad=1.0,
 @register("sparse_adagrad_update", nout=2)
 def _sparse_adagrad_update(lr=0.01, epsilon=1e-7, wd=0.0, rescale_grad=1.0,
                            clip_gradient=-1.0):
-    """Row-sparse AdaGrad (reference: _sparse_adagrad_update,
-    optimizer_op.cc sparse kernels): history and weight update only on the
-    gradient's active rows — the lazy-update semantics embeddings rely on."""
     def f(weight, history, grad_rows, indices):
-        idx = indices.astype(jnp.int32)
-        g = grad_rows * rescale_grad
-        if clip_gradient > 0:
-            g = jnp.clip(g, -clip_gradient, clip_gradient)
-        if wd > 0:
-            g = g + wd * weight[idx]
-        h_rows = history[idx] + g * g
-        new_hist = history.at[idx].set(h_rows)
-        new_w = weight.at[idx].add(-lr * g / (jnp.sqrt(h_rows) + epsilon))
-        return new_w, new_hist
+        return sparse_adagrad_core(weight, history, grad_rows, indices,
+                                   lr, wd, epsilon, rescale_grad,
+                                   clip_gradient)
 
     return f
 
@@ -279,25 +357,10 @@ def _sparse_adagrad_update(lr=0.01, epsilon=1e-7, wd=0.0, rescale_grad=1.0,
 def _sparse_adam_update(lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                         wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                         t=1.0):
-    """Lazy row-sparse Adam (reference: adam_update FComputeEx with
-    lazy_update=1, optimizer_op.cc AdamLazyUpdate): mean/var/weight move
-    ONLY on the gradient's active rows; bias correction uses the global
-    step count, matching the reference's lazy semantics (inactive rows'
-    moments do not decay)."""
     def f(weight, mean, var, grad_rows, indices):
-        idx = indices.astype(jnp.int32)
-        g = grad_rows * rescale_grad
-        if clip_gradient > 0:
-            g = jnp.clip(g, -clip_gradient, clip_gradient)
-        w_rows = weight[idx]
-        g = g + wd * w_rows
-        m_rows = beta1 * mean[idx] + (1 - beta1) * g
-        v_rows = beta2 * var[idx] + (1 - beta2) * g * g
-        mhat = m_rows / (1 - beta1 ** t)
-        vhat = v_rows / (1 - beta2 ** t)
-        upd = lr * mhat / (jnp.sqrt(vhat) + epsilon)
-        return (weight.at[idx].set(w_rows - upd),
-                mean.at[idx].set(m_rows), var.at[idx].set(v_rows))
+        return sparse_adam_core(weight, mean, var, grad_rows, indices,
+                                lr, wd, t, beta1, beta2, epsilon,
+                                rescale_grad, clip_gradient)
 
     return f
 
@@ -305,26 +368,10 @@ def _sparse_adam_update(lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
 @register("sparse_ftrl_update", nout=3)
 def _sparse_ftrl_update(lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
                         rescale_grad=1.0, clip_gradient=-1.0):
-    """Row-sparse FTRL (reference: ftrl_update FComputeEx,
-    MXNET_ADD_SPARSE_OP_ALIAS optimizer_op.cc:848): z/n/weight update only
-    the gradient's active rows."""
     def f(weight, z, n, grad_rows, indices):
-        idx = indices.astype(jnp.int32)
-        g = grad_rows * rescale_grad
-        if clip_gradient > 0:
-            g = jnp.clip(g, -clip_gradient, clip_gradient)
-        w_rows = weight[idx]
-        n_rows = n[idx]
-        sigma = (jnp.sqrt(n_rows + g * g) - jnp.sqrt(n_rows)) / lr
-        z_rows = z[idx] + g - sigma * w_rows
-        n_rows = n_rows + g * g
-        new_w_rows = jnp.where(
-            jnp.abs(z_rows) > lamda1,
-            -(z_rows - jnp.sign(z_rows) * lamda1) /
-            ((beta + jnp.sqrt(n_rows)) / lr + wd),
-            0.0)
-        return (weight.at[idx].set(new_w_rows), z.at[idx].set(z_rows),
-                n.at[idx].set(n_rows))
+        return sparse_ftrl_core(weight, z, n, grad_rows, indices, lr,
+                                lamda1, beta, wd, rescale_grad,
+                                clip_gradient)
 
     return f
 

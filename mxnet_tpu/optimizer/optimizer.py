@@ -161,8 +161,55 @@ class Optimizer:
         else:
             self._apply(weight, grad, state, _f32(lr), _f32(wd), t)
 
+    # -- pure per-tensor step (single-param AND fused multi-tensor) ---------
+    _step_spec = None   # (raw_step, state_keys, needs_t, elementwise)
+    _fusable = None     # same spec, or None when fusion is unsound (RNG, ...)
+
+    def _register_step(self, step, state_keys=(), needs_t=False,
+                       fusable=True, elementwise=False):
+        """Declare this optimizer's pure per-tensor recurrence.
+
+        ``step(w, *states, g, lr, wd[, t])`` returns the new weight (and the
+        new states, in ``state_keys`` order). ONE declaration serves both
+        execution paths: the single-param jitted step driven by ``_apply``,
+        and Trainer's fused multi-tensor program, which tree-maps the same
+        raw fn over every parameter in one compiled call (reference: the
+        multi_sgd/multi_*_update kernels, optimizer_op.cc:49-1044).
+        ``fusable=False`` keeps the single-param step but opts out of fusion
+        (e.g. steps with side inputs Trainer cannot provide).
+        ``elementwise=True`` asserts the recurrence is purely per-element
+        (no per-tensor reductions like LAMB's trust-ratio norms), which lets
+        the fused path concatenate tiny tensors into one flat kernel.
+        """
+        keys = tuple(state_keys)
+        self._step_spec = (step, keys, needs_t, elementwise)
+        self._step = _jit_step(step, 1 + len(keys))
+        if fusable:
+            self._fusable = self._step_spec
+
+    @property
+    def fused_step(self):
+        """(raw_fn, state_keys, needs_t, elementwise) for Trainer's fused
+        multi-tensor path, or None when this optimizer cannot be fused."""
+        return self._fusable
+
     def _apply(self, weight, grad, state, lr, wd, t):
-        raise NotImplementedError
+        spec = self._step_spec
+        if spec is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} declares no per-tensor step")
+        step, keys, needs_t, _ = spec
+        args = [weight._data, *(state[k]._data for k in keys), grad._data,
+                lr, wd]
+        if needs_t:
+            args.append(_f32(t))
+        out = self._step(*args)
+        if keys:
+            weight._set_data(out[0])
+            for k, arr in zip(keys, out[1:]):
+                state[k]._set_data(arr)
+        else:
+            weight._set_data(out)
 
     def _apply_sparse(self, weight, grad, state, lr, wd, t):
         """Lazy row-sparse update; return True when handled. Base: not
@@ -203,6 +250,39 @@ def _jit_step(fn, n_donate):
 _rescale_jit = jax.jit(lambda g, r: g * r)
 
 
+# -- lazy row-sparse kernels -------------------------------------------------
+# ONE jitted program per kernel shape, shared by every optimizer instance:
+# all hyper-parameters (lr, wd, t, betas, rescale_grad, clip_gradient) ride
+# as runtime array operands, so a changing LR schedule or a growing step
+# count never recompiles and Op._fn_cache never grows one program per step.
+# Weight/state buffers are donated: in-place row updates in HBM.
+_sparse_jits: dict = {}
+_sparse_trace_counts: dict = {}   # kernel name -> number of TRACES (tests)
+
+
+def _sparse_fn(name):
+    ent = _sparse_jits.get(name)
+    if ent is None:
+        from ..ops import optimizer_ops as _oo
+
+        core, donate = {
+            "sgd": (_oo.sparse_sgd_core, (0,)),
+            "adagrad": (_oo.sparse_adagrad_core, (0, 1)),
+            "adam": (_oo.sparse_adam_core, (0, 1, 2)),
+            "ftrl": (_oo.sparse_ftrl_core, (0, 1, 2)),
+            "group_adagrad": (_oo.sparse_group_adagrad_core, (0, 1)),
+        }[name]
+
+        def counted(*args, _core=core, _name=name):
+            # body executes at trace time only: counts recompiles, not calls
+            _sparse_trace_counts[_name] = \
+                _sparse_trace_counts.get(_name, 0) + 1
+            return _core(*args)
+
+        ent = _sparse_jits[name] = jax.jit(counted, donate_argnums=donate)
+    return ent
+
+
 @register
 class SGD(Optimizer):
     """SGD with momentum/nesterov (reference: optimizer_op.cc sgd_mom_update)."""
@@ -227,38 +307,22 @@ class SGD(Optimizer):
             wf = w.astype(jnp.float32)
             return (wf - lr * (g + wd * wf)).astype(w.dtype)
 
-        self._step = _jit_step(step, 2)
-        self._step_nomom = _jit_step(step_nomom, 1)
-        # fused multi-tensor layout (Trainer): raw fn, state keys, needs_t
         if momentum == 0.0:
-            self._fusable = (step_nomom, (), False)
+            self._register_step(step_nomom, elementwise=True)
         else:
-            self._fusable = (step, ("mom",), False)
+            self._register_step(step, ("mom",), elementwise=True)
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return {}
         return {"mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
 
-    def _apply(self, w, g, state, lr, wd, t):
-        if self.momentum == 0.0:
-            w._set_data(self._step_nomom(w._data, g._data, lr, wd))
-        else:
-            new_w, new_m = self._step(w._data, state["mom"]._data, g._data,
-                                      lr, wd)
-            w._set_data(new_w)
-            state["mom"]._set_data(new_m)
-
     def _apply_sparse(self, weight, grad, state, lr, wd, t):
         if self.momentum != 0.0 or not self.lazy_update:
             return False  # dense semantics requested (or dense momentum)
-        from ..ops.registry import get_op
-
-        fn = get_op("sparse_sgd_update").fn(
-            lr=float(lr), wd=float(wd), rescale_grad=self.rescale_grad,
-            clip_gradient=self._clip_arg())
-        weight._set_data(fn(weight._data, grad.data._data,
-                            grad.indices._data))
+        weight._set_data(_sparse_fn("sgd")(
+            weight._data, grad.data._data, grad.indices._data, lr, wd,
+            _f32(self.rescale_grad), _f32(self._clip_arg())))
         return True
 
 
@@ -275,15 +339,10 @@ class NAG(Optimizer):
             mom = self.momentum * mom + g
             return w - lr * (g + self.momentum * mom), mom
 
-        self._step = _jit_step(step, 2)
+        self._register_step(step, ("mom",))
 
     def create_state(self, index, weight):
         return {"mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
-
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, new_m = self._step(w._data, state["mom"]._data, g._data, lr, wd)
-        w._set_data(new_w)
-        state["mom"]._set_data(new_m)
 
 
 class _AdamBase(Optimizer):
@@ -315,38 +374,26 @@ class _AdamBase(Optimizer):
                 upd = upd + wd * wf
             return (wf - lr * upd).astype(w.dtype), m, v
 
-        self._step = _jit_step(step, 3)
-        self._fusable = (step, ("mean", "var"), True)
+        self._register_step(step, ("mean", "var"), needs_t=True,
+                            elementwise=True)
 
     def create_state(self, index, weight):
         return {"mean": NDArray(jnp.zeros(weight.shape, jnp.float32)),
                 "var": NDArray(jnp.zeros(weight.shape, jnp.float32))}
 
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, m, v = self._step(w._data, state["mean"]._data,
-                                 state["var"]._data, g._data, lr, wd,
-                                 _f32(t))
-        w._set_data(new_w)
-        state["mean"]._set_data(m)
-        state["var"]._set_data(v)
-
     def _apply_sparse(self, weight, grad, state, lr, wd, t):
         """Lazy row-sparse Adam (reference: adam_update lazy_update=1):
         moments and weight move only on active rows. Decoupled weight
-        decay (AdamW) touches every row by definition — dense fallback."""
+        decay (AdamW) touches every row by definition — dense fallback.
+        lr/wd/t ride as runtime operands: step N+1 reuses step N's program."""
         if self._decoupled_wd or not self.lazy_update \
                 or not self.correct_bias:
             return False
-        from ..ops.registry import get_op
-
-        fn = get_op("sparse_adam_update").fn(
-            lr=float(lr), beta1=self.beta1, beta2=self.beta2,
-            epsilon=self.epsilon, wd=float(wd),
-            rescale_grad=self.rescale_grad,
-            clip_gradient=self._clip_arg(), t=float(t))
-        new_w, m, v = fn(weight._data, state["mean"]._data,
-                         state["var"]._data, grad.data._data,
-                         grad.indices._data)
+        new_w, m, v = _sparse_fn("adam")(
+            weight._data, state["mean"]._data, state["var"]._data,
+            grad.data._data, grad.indices._data, lr, wd, _f32(t),
+            _f32(self.beta1), _f32(self.beta2), _f32(self.epsilon),
+            _f32(self.rescale_grad), _f32(self._clip_arg()))
         weight._set_data(new_w)
         state["mean"]._set_data(m)
         state["var"]._set_data(v)
@@ -381,18 +428,12 @@ class Adamax(Optimizer):
             u = jnp.maximum(b2 * u, jnp.abs(g))
             return w - lr / (1 - b1 ** t) * m / (u + 1e-8), m, u
 
-        self._step = _jit_step(step, 3)
+        self._register_step(step, ("mean", "u"), needs_t=True,
+                            elementwise=True)
 
     def create_state(self, index, weight):
         return {"mean": NDArray(jnp.zeros(weight.shape, jnp.float32)),
                 "u": NDArray(jnp.zeros(weight.shape, jnp.float32))}
-
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, m, u = self._step(w._data, state["mean"]._data,
-                                 state["u"]._data, g._data, lr, wd, _f32(t))
-        w._set_data(new_w)
-        state["mean"]._set_data(m)
-        state["u"]._set_data(u)
 
 
 @register
@@ -411,10 +452,10 @@ class Nadam(Optimizer):
             upd = (b1 * mhat + (1 - b1) * g / (1 - b1 ** t))
             return w - lr * upd / (jnp.sqrt(vhat) + eps), m, v
 
-        self._step = _jit_step(step, 3)
+        self._register_step(step, ("mean", "var"), needs_t=True,
+                            elementwise=True)
 
     create_state = _AdamBase.create_state
-    _apply = _AdamBase._apply
 
 
 @register
@@ -443,20 +484,11 @@ class RMSProp(Optimizer):
             return w, n, g_avg, mom
 
         rho = rho
-        self._step = _jit_step(step, 4)
+        self._register_step(step, ("n", "g", "mom"), elementwise=True)
 
     def create_state(self, index, weight):
         z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))  # noqa: E731
         return {"n": z(), "g": z(), "mom": z()}
-
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, n, ga, mom = self._step(w._data, state["n"]._data,
-                                       state["g"]._data, state["mom"]._data,
-                                       g._data, lr, wd)
-        w._set_data(new_w)
-        state["n"]._set_data(n)
-        state["g"]._set_data(ga)
-        state["mom"]._set_data(mom)
 
 
 @register
@@ -470,25 +502,16 @@ class AdaGrad(Optimizer):
             h = h + g * g
             return w - lr * g / (jnp.sqrt(h) + epsilon), h
 
-        self._step = _jit_step(step, 2)
+        self._register_step(step, ("history",), elementwise=True)
 
     def create_state(self, index, weight):
         return {"history": NDArray(jnp.zeros(weight.shape, jnp.float32))}
 
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, h = self._step(w._data, state["history"]._data, g._data, lr, wd)
-        w._set_data(new_w)
-        state["history"]._set_data(h)
-
     def _apply_sparse(self, weight, grad, state, lr, wd, t):
-        from ..ops.registry import get_op
-
-        fn = get_op("sparse_adagrad_update").fn(
-            lr=float(lr), epsilon=self._eps, wd=float(wd),
-            rescale_grad=self.rescale_grad,
-            clip_gradient=self._clip_arg())
-        new_w, new_h = fn(weight._data, state["history"]._data,
-                          grad.data._data, grad.indices._data)
+        new_w, new_h = _sparse_fn("adagrad")(
+            weight._data, state["history"]._data, grad.data._data,
+            grad.indices._data, lr, wd, _f32(self._eps),
+            _f32(self.rescale_grad), _f32(self._clip_arg()))
         weight._set_data(new_w)
         state["history"]._set_data(new_h)
         return True
@@ -506,18 +529,12 @@ class AdaDelta(Optimizer):
             acc_d = rho * acc_d + (1 - rho) * delta * delta
             return w - lr * delta, acc_g, acc_d
 
-        self._step = _jit_step(step, 3)
+        self._register_step(step, ("acc_g", "acc_delta"),
+                            elementwise=True)
 
     def create_state(self, index, weight):
         z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))  # noqa: E731
         return {"acc_g": z(), "acc_delta": z()}
-
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, ag_, ad = self._step(w._data, state["acc_g"]._data,
-                                    state["acc_delta"]._data, g._data, lr, wd)
-        w._set_data(new_w)
-        state["acc_g"]._set_data(ag_)
-        state["acc_delta"]._set_data(ad)
 
 
 @register
@@ -538,29 +555,19 @@ class Ftrl(Optimizer):
                 0.0)
             return w, z, n
 
-        self._step = _jit_step(step, 3)
+        self._register_step(step, ("z", "n"), elementwise=True)
 
     def create_state(self, index, weight):
         z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))  # noqa: E731
         return {"z": z(), "n": z()}
 
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, z, n = self._step(w._data, state["z"]._data, state["n"]._data,
-                                 g._data, lr, wd)
-        w._set_data(new_w)
-        state["z"]._set_data(z)
-        state["n"]._set_data(n)
-
     def _apply_sparse(self, weight, grad, state, lr, wd, t):
         """Lazy row-sparse FTRL (reference: ftrl_update sparse alias)."""
-        from ..ops.registry import get_op
-
-        fn = get_op("sparse_ftrl_update").fn(
-            lr=float(lr), lamda1=self._lamda1, beta=self._beta,
-            wd=float(wd), rescale_grad=self.rescale_grad,
-            clip_gradient=self._clip_arg())
-        new_w, z, n = fn(weight._data, state["z"]._data, state["n"]._data,
-                         grad.data._data, grad.indices._data)
+        new_w, z, n = _sparse_fn("ftrl")(
+            weight._data, state["z"]._data, state["n"]._data,
+            grad.data._data, grad.indices._data, lr, _f32(self._lamda1),
+            _f32(self._beta), wd, _f32(self.rescale_grad),
+            _f32(self._clip_arg()))
         weight._set_data(new_w)
         state["z"]._set_data(z)
         state["n"]._set_data(n)
@@ -582,20 +589,12 @@ class FTML(Optimizer):
             z = b1 * z + (1 - b1) * g - (d_new - b1 * d) * w
             return -z / d_new, d_new, s, z
 
-        self._step = _jit_step(step, 4)
+        self._register_step(step, ("d", "s", "z"), needs_t=True,
+                            elementwise=True)
 
     def create_state(self, index, weight):
         z = lambda: NDArray(jnp.zeros(weight.shape, jnp.float32))  # noqa: E731
         return {"d": z(), "s": z(), "z": z()}
-
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, d, s, z = self._step(w._data, state["d"]._data,
-                                    state["s"]._data, state["z"]._data,
-                                    g._data, lr, wd, _f32(t))
-        w._set_data(new_w)
-        state["d"]._set_data(d)
-        state["s"]._set_data(s)
-        state["z"]._set_data(z)
 
 
 @register
@@ -615,22 +614,15 @@ class Signum(Optimizer):
             g = self._pre(g) + wd * w
             return w - lr * jnp.sign(g)
 
-        self._step = _jit_step(step, 2)
-        self._step_nomom = _jit_step(step_nomom, 1)
+        if momentum == 0.0:
+            self._register_step(step_nomom, elementwise=True)
+        else:
+            self._register_step(step, ("mom",), elementwise=True)
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return {}
         return {"mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
-
-    def _apply(self, w, g, state, lr, wd, t):
-        if self.momentum == 0.0:
-            w._set_data(self._step_nomom(w._data, g._data, lr, wd))
-        else:
-            new_w, mom = self._step(w._data, state["mom"]._data, g._data,
-                                    lr, wd)
-            w._set_data(new_w)
-            state["mom"]._set_data(mom)
 
 
 @register
@@ -664,10 +656,10 @@ class LAMB(Optimizer):
                               1.0)
             return (wf - lr * ratio * r).astype(w.dtype), m, v
 
-        self._step = _jit_step(step, 3)
+        # NOT elementwise: the trust ratio reduces over the whole tensor
+        self._register_step(step, ("mean", "var"), needs_t=True)
 
     create_state = _AdamBase.create_state
-    _apply = _AdamBase._apply
 
 
 @register
@@ -690,12 +682,10 @@ class LARS(Optimizer):
             mom = self.momentum * mom + trust * lr * g
             return w - mom, mom
 
-        self._step = _jit_step(step, 2)
+        self._register_step(step, ("mom",))
 
     def create_state(self, index, weight):
         return {"mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
-
-    _apply = NAG._apply
 
 
 @register
@@ -730,10 +720,10 @@ class LANS(Optimizer):
             upd = b1 * r1 + (1 - b1) * r2
             return (wf - lr * upd).astype(w.dtype), m, v
 
-        self._step = _jit_step(step, 3)
+        # NOT elementwise: normalized grad + trust ratio are whole-tensor
+        self._register_step(step, ("mean", "var"), needs_t=True)
 
     create_state = _AdamBase.create_state
-    _apply = _AdamBase._apply
 
 
 @register
@@ -751,10 +741,10 @@ class AdaBelief(Optimizer):
             vhat = v / (1 - b2 ** t)
             return w - lr * mhat / (jnp.sqrt(vhat) + eps), m, v
 
-        self._step = _jit_step(step, 3)
+        self._register_step(step, ("mean", "var"), needs_t=True,
+                            elementwise=True)
 
     create_state = _AdamBase.create_state
-    _apply = _AdamBase._apply
 
 
 @register
@@ -788,19 +778,12 @@ class DCASGD(Optimizer):
             mom = self.momentum * mom - lr * g
             return w + mom, w, mom
 
-        self._step = _jit_step(step, 3)
+        self._register_step(step, ("prev", "mom"), elementwise=True)
 
     def create_state(self, index, weight):
         # independent copy: prev must not alias the (donated) weight buffer
         return {"prev": NDArray(jnp.array(weight._data, copy=True)),
                 "mom": NDArray(jnp.zeros(weight.shape, jnp.float32))}
-
-    def _apply(self, w, g, state, lr, wd, t):
-        new_w, prev, mom = self._step(w._data, state["prev"]._data,
-                                      state["mom"]._data, g._data, lr, wd)
-        w._set_data(new_w)
-        state["prev"]._set_data(prev)
-        state["mom"]._set_data(mom)
 
 
 # common aliases used in reference scripts
@@ -834,7 +817,9 @@ class GroupAdaGrad(Optimizer):
                              keepdims=True)
             return w - lr * g / (jnp.sqrt(h) + epsilon), h
 
-        self._step = _jit_step(step, 2)
+        # NOT elementwise: history reduces over the row (and its state
+        # shape differs from the weight's, which flat-concat cannot carry)
+        self._register_step(step, ("history",))
 
     def create_state(self, index, weight):
         shape = (weight.shape[0],) + (1,) * (len(weight.shape) - 1) \
@@ -843,24 +828,17 @@ class GroupAdaGrad(Optimizer):
 
     def _apply(self, w, g, state, lr, wd, t):
         self._reject_wd(float(wd))
-        new_w, h = self._step(w._data, state["history"]._data, g._data,
-                              lr, wd)
-        w._set_data(new_w)
-        state["history"]._set_data(h)
+        super()._apply(w, g, state, lr, wd, t)
 
     def _apply_sparse(self, weight, grad, state, lr, wd, t):
         """Lazy row-sparse path: only the touched rows update (the whole
         point of GroupAdaGrad — O(batch-rows) embedding steps). Same
         pre-processing as the dense path: rescale then clip, no wd."""
         self._reject_wd(float(wd))
-        rows = grad.indices._data
-        g = self._pre(grad.data._data * self.rescale_grad)
-        h = state["history"]._data
-        h_rows = h[rows] + jnp.mean(
-            g * g, axis=tuple(range(1, g.ndim)), keepdims=True)
-        h = h.at[rows].set(h_rows)
-        w = weight._data
-        upd = lr * g / (jnp.sqrt(h_rows) + self._eps)
-        weight._set_data(w.at[rows].add(-upd))
-        state["history"]._set_data(h)
+        new_w, new_h = _sparse_fn("group_adagrad")(
+            weight._data, state["history"]._data, grad.data._data,
+            grad.indices._data, lr, _f32(self._eps),
+            _f32(self.rescale_grad), _f32(self._clip_arg()))
+        weight._set_data(new_w)
+        state["history"]._set_data(new_h)
         return True  # handled: _update_one must not densify and re-apply

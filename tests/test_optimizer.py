@@ -149,7 +149,7 @@ def test_fused_multi_update_matches_per_param():
         loss_fn = gluon.loss.L2Loss()
         tr_a = gluon.Trainer(net_a.collect_params(), name, dict(args))
         tr_b = gluon.Trainer(net_b.collect_params(), name, dict(args))
-        tr_b._try_fused_update = lambda active: False
+        tr_b._fuse = False
         for _ in range(3):
             for net, tr in ((net_a, tr_a), (net_b, tr_b)):
                 with autograd.record():
@@ -159,6 +159,113 @@ def test_fused_multi_update_matches_per_param():
         wa = net_a.collect_params()["0.weight"].data().asnumpy()
         wb = net_b.collect_params()["0.weight"].data().asnumpy()
         assert onp.abs(wa - wb).max() < 1e-6, name
+
+
+def _make_trainer(name, args, shapes, seed, fuse):
+    """Trainer over raw Parameters with deterministic weights; grads are set
+    directly on the grad buffers (no network needed)."""
+    from mxnet_tpu.gluon import Trainer
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    rng = onp.random.RandomState(seed)
+    params = []
+    for j, shp in enumerate(shapes):
+        p = Parameter(name=f"p{j}", shape=shp)
+        p.initialize()
+        p.set_data(np.array(rng.standard_normal(shp).astype("float32")))
+        params.append(p)
+    tr = Trainer(params, name, dict(args))
+    tr._fuse = fuse
+    return tr, params
+
+
+@pytest.mark.parametrize("name,args", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-4}),
+    ("adamw", {"learning_rate": 1e-3, "wd": 1e-2}),
+    ("lamb", {"learning_rate": 1e-2}),
+])
+def test_fused_step_matches_per_param(name, args):
+    """The fused multi-tensor program applies the SAME per-element
+    arithmetic as the per-param path — weights AND optimizer states —
+    across steps with a changing learning rate. Shapes mix tiny tensors
+    (flat-concat branch of the elementwise fusion) with one above the
+    flatten threshold. Tolerance is ulp-level, not zero: XLA's instruction
+    selection (FMA contraction) differs between separately compiled
+    programs, so strict bit-equality across them is not guaranteed even
+    for identical expression trees; a plumbing bug (wrong lr/t/wd wiring,
+    swapped state slots) produces errors many orders of magnitude above
+    this bound."""
+    shapes = [(4, 3), (7,), (70, 70), (5,)]
+    tr_f, ps_f = _make_trainer(name, args, shapes, seed=3, fuse=True)
+    tr_p, ps_p = _make_trainer(name, args, shapes, seed=3, fuse=False)
+    rng = onp.random.RandomState(0)
+    for step in range(5):
+        if step == 2:  # LR schedule change mid-run
+            for tr in (tr_f, tr_p):
+                tr.set_learning_rate(args["learning_rate"] * 0.5)
+        grads = [rng.standard_normal(s).astype("float32") for s in shapes]
+        for tr, params in ((tr_f, ps_f), (tr_p, ps_p)):
+            for p, g in zip(params, grads):
+                p.grad()._set_data(np.array(g)._data)
+            tr.update(2)  # rescale_grad = 1/2, exact in f32
+    assert tr_f._fused_dispatches == 5   # ONE compiled call per step
+    assert tr_p._fused_dispatches == 0
+    for pf, pp in zip(ps_f, ps_p):
+        onp.testing.assert_allclose(
+            pf.data().asnumpy(), pp.data().asnumpy(),
+            rtol=1e-6, atol=1e-7, err_msg=f"{name}:{pf.name}")
+    for sf, sp in zip(tr_f._states, tr_p._states):
+        for k in sf:
+            onp.testing.assert_allclose(
+                sf[k].asnumpy(), sp[k].asnumpy(),
+                rtol=1e-6, atol=1e-7, err_msg=f"{name}:{k}")
+
+
+def test_fused_step_zero_recompiles_across_steps():
+    """Scalar schedule inputs (lr, t, wd, rescale) are runtime operands:
+    steps 2..N trigger ZERO new traces even under a decaying LR schedule
+    and varying batch size (reference: the static-attr retrace bug class —
+    optimizer hypers must never bake into the compiled program)."""
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    shapes = [(4, 3), (7,), (2, 5)]
+    tr, params = _make_trainer(
+        "sgd", {"learning_rate": 0.1, "momentum": 0.9,
+                "lr_scheduler": FactorScheduler(step=1, factor=0.7,
+                                                base_lr=0.1)},
+        shapes, seed=1, fuse=True)
+    rng = onp.random.RandomState(7)
+    for step in range(6):
+        for p in params:
+            p.grad()._set_data(
+                np.array(rng.standard_normal(p.shape)
+                         .astype("float32"))._data)
+        tr.update(step + 1)  # batch size changes -> rescale changes
+    assert tr._fused_traces == 1, tr._fused_traces
+    assert tr._fused_dispatches == 6
+
+
+def test_sparse_kernel_cache_no_per_step_growth():
+    """The lazy row-sparse kernels take t/lr/beta as runtime operands: the
+    jit cache must not grow as steps advance (the old static-attr plumbing
+    recompiled every step because t was baked into the op attrs)."""
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    from mxnet_tpu.optimizer.optimizer import _sparse_trace_counts
+
+    for name in ("sgd", "adam", "adagrad", "ftrl"):
+        opt = optimizer.create(name, learning_rate=0.1)
+        w = np.array(onp.ones((6, 3), "float32"))
+        st = opt.create_state(0, w)
+        g = RowSparseNDArray(onp.full((2, 3), 0.5, "float32"), [1, 4],
+                             (6, 3))
+        opt.update(0, w, g, st)          # first call may trace
+        baseline = dict(_sparse_trace_counts)
+        for _ in range(4):
+            opt.update(0, w, g, st)      # t advances every step
+        opt.set_learning_rate(0.01)      # lr changes too
+        opt.update(0, w, g, st)
+        assert dict(_sparse_trace_counts) == baseline, name
 
 
 def test_sparse_grad_lazy_update_sgd_and_adagrad():
