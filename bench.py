@@ -4,7 +4,7 @@ Default metric mirrors the reference's headline benchmark
 (example/image-classification/benchmark_score.py; docs/.../faq/perf.md —
 V100 fp16 ResNet-50 batch 128: 2355.04 img/s, BASELINE.md). Select with
 argv[1] or BENCH env: resnet (default) | resnet_train | lstm_lm |
-bert_pretrain | bert_large_pretrain | optimizer_step.
+bert_pretrain | bert_large_pretrain | optimizer_step | telemetry_overhead.
 
 Robustness contract (round-1 postmortem): any failure — backend init,
 compile, OOM — still emits a parseable JSON line with an "error" field and
@@ -231,20 +231,10 @@ def bench_bert_pretrain(size="base"):
             "mfu": _mfu(tok_s * 6 * BERT_PARAMS[size])}
 
 
-def bench_optimizer_step():
-    """Fused vs per-param optimizer step over a ResNet-50-sized synthetic
-    parameter set (~160 tensors, ~25M params): Trainer.update with the
-    fused multi-tensor path on vs off. Reports updates/sec both ways and
-    per-step compiled-call counts (fused: O(#buckets); per-param:
-    O(#params))."""
-    import jax.numpy as jnp
-
-    from mxnet_tpu import gluon, optimizer
-    from mxnet_tpu.gluon.parameter import Parameter
-
-    # ResNet-50-shaped tensor set: stem conv + BN pair, 16 bottleneck
-    # blocks (3 conv kernels + 3 BN gamma/beta pairs each), a downsample
-    # conv + BN pair per stage, and the fc head — 163 tensors, ~25M params
+def _resnet50_param_shapes():
+    """ResNet-50-shaped tensor set: stem conv + BN pair, 16 bottleneck
+    blocks (3 conv kernels + 3 BN gamma/beta pairs each), a downsample
+    conv + BN pair per stage, and the fc head — 163 tensors, ~25M params."""
     shapes = [(64, 3, 7, 7), (64,), (64,)]
     for blocks, cin, cmid in [(3, 256, 64), (4, 512, 128),
                               (6, 1024, 256), (3, 2048, 512)]:
@@ -255,18 +245,38 @@ def bench_optimizer_step():
                        (cmid, cmid, 3, 3), (cmid,), (cmid,),
                        (cin, cmid, 1, 1), (cin,), (cin,)]
     shapes += [(1000, 2048), (1000,)]
-    rng = onp.random.RandomState(0)
+    return shapes
+
+
+def _build_param_set(shapes, seed=0):
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    rng = onp.random.RandomState(seed)
+    params = []
+    for j, shp in enumerate(shapes):
+        p = Parameter(name=f"p{j}", shape=shp)
+        p.initialize()
+        p.set_data(jnp.asarray(rng.standard_normal(shp), jnp.float32))
+        p.grad()._set_data(
+            jnp.asarray(rng.standard_normal(shp), jnp.float32))
+        params.append(p)
+    return params
+
+
+def bench_optimizer_step():
+    """Fused vs per-param optimizer step over a ResNet-50-sized synthetic
+    parameter set (~160 tensors, ~25M params): Trainer.update with the
+    fused multi-tensor path on vs off. Reports updates/sec both ways and
+    per-step compiled-call counts (fused: O(#buckets); per-param:
+    O(#params))."""
+    from mxnet_tpu import gluon, optimizer
+
+    shapes = _resnet50_param_shapes()
 
     def build():
-        params = []
-        for j, shp in enumerate(shapes):
-            p = Parameter(name=f"p{j}", shape=shp)
-            p.initialize()
-            p.set_data(jnp.asarray(rng.standard_normal(shp), jnp.float32))
-            p.grad()._set_data(
-                jnp.asarray(rng.standard_normal(shp), jnp.float32))
-            params.append(p)
-        return params
+        return _build_param_set(shapes)
 
     WARMUP, ITERS = 3, 10
 
@@ -298,6 +308,72 @@ def bench_optimizer_step():
             "per_param_updates_per_sec": round(pp_ups, 1),
             "dispatches_fused": fused_disp,
             "dispatches_per_param": pp_disp,
+            "mfu": None}
+
+
+def bench_telemetry_overhead():
+    """Enabled-telemetry overhead on the fused optimizer_step bench.
+
+    One trainer, jit caches warmed once, then interleaved off/on timing
+    trials; the reported overhead is the ratio of the min-of-trials each
+    way — robust to one-off scheduler noise. BENCH_TELEM_SMALL=1 shrinks
+    the tensor set (for the not-slow test); the acceptance bar is < 2%.
+    """
+    import jax
+
+    from mxnet_tpu import gluon, optimizer, telemetry
+
+    shapes = _resnet50_param_shapes()
+    small = os.environ.get("BENCH_TELEM_SMALL", "") == "1"
+    if small:
+        shapes = shapes[:40]
+    params = _build_param_set(shapes)
+    tr = gluon.Trainer(params, optimizer.SGD(learning_rate=0.01,
+                                             momentum=0.9))
+
+    # the small set's per-iter time is tiny, so buy noise robustness with
+    # more, longer trials — still ~2s of measurement
+    WARMUP, ITERS, TRIALS = (3, 25, 8) if small else (3, 10, 5)
+
+    was_on = telemetry.is_enabled()
+    try:
+        # warm the jit caches under BOTH modes so neither timed loop pays
+        # a trace (the observer is baked in at trace time either way; only
+        # the runtime ON checks differ between modes)
+        for enabled in (False, True):
+            telemetry.enable() if enabled else telemetry.disable()
+            for _ in range(WARMUP):
+                tr.update(32)
+        jax.block_until_ready([p.data()._data for p in params])
+
+        def timed(enabled):
+            telemetry.enable() if enabled else telemetry.disable()
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                tr.update(32)
+            jax.block_until_ready([p.data()._data for p in params])
+            return time.perf_counter() - t0
+
+        t_off, t_on = [], []
+        for _ in range(TRIALS):
+            t_off.append(timed(False))
+            t_on.append(timed(True))
+    finally:
+        telemetry.enable() if was_on else telemetry.disable()
+
+    # each off/on pair runs back-to-back, so ambient load is comparable
+    # within a pair; the min over pair ratios filters box noise that a
+    # min-of-each-side comparison cannot (no trial window may be quiet)
+    overhead = min(on / max(off, 1e-12)
+                   for off, on in zip(t_off, t_on)) - 1.0
+    pct = overhead * 100.0
+    return {"metric": "telemetry_overhead_optimizer_step",
+            "value": round(pct, 3), "unit": "%",
+            "vs_baseline": round(pct / 2.0, 3),  # fraction of the 2% budget
+            "threshold_pct": 2.0,
+            "n_tensors": len(shapes),
+            "updates_per_sec_off": round(len(shapes) * ITERS / min(t_off), 1),
+            "updates_per_sec_on": round(len(shapes) * ITERS / min(t_on), 1),
             "mfu": None}
 
 
@@ -356,7 +432,8 @@ def main():
               "bert_pretrain": bench_bert_pretrain,
               "bert_large_pretrain": functools.partial(bench_bert_pretrain,
                                                        "large"),
-              "optimizer_step": bench_optimizer_step}[which]
+              "optimizer_step": bench_optimizer_step,
+              "telemetry_overhead": bench_telemetry_overhead}[which]
         # resolve the backend up front through the hardened probe: a hung
         # or dead TPU runtime must not kill the bench (round-1 failure:
         # raw RuntimeError) — and must not silently publish a CPU number

@@ -68,6 +68,8 @@ from . import callback
 from . import model
 from .ndarray import sparse
 from . import profiler
+from . import telemetry
+from . import monitor
 from . import runtime
 from . import util
 from . import parallel

@@ -80,6 +80,9 @@ class CachedOp:
     """
 
     def __init__(self, sym, var_nodes, aux_updates=(), name="cached_op"):
+        from . import telemetry as _telemetry
+        from .ops.registry import _observe_compiles
+
         self.sym = sym
         self._var_nodes = list(var_nodes)
         self._aux_targets = [t for t, _ in aux_updates]
@@ -87,7 +90,11 @@ class CachedOp:
         self._n_main = len(sym._entries)
         fn, uses_rng = build_executor(entries, self._var_nodes)
         self._raw_fn = fn  # un-jitted executor (AOT tooling / __graft_entry__)
-        self._jitted = jax.jit(fn)
+        # the watchdog observer runs at trace time only: each jit cache miss
+        # of this program (a new input signature) reports one compile
+        self._jitted = jax.jit(_observe_compiles(fn, f"cached_op:{name}",
+                                                 None))
+        self._telemetry = _telemetry
         self._uses_rng = uses_rng
         # wrap as a registered-op-shaped object so registry.invoke records it
         # on the autograd tape as ONE node
@@ -104,7 +111,24 @@ class CachedOp:
                 f"CachedOp expects {len(self._var_nodes)} inputs, "
                 f"got {len(inputs)}"
             )
-        outs = invoke(self._op, inputs, {})
+        tm = self._telemetry
+        if tm.ON:
+            # attribute host time to compile vs steady-state call: a trace
+            # of this program reports record_compile synchronously inside
+            # invoke, so the compile-counter delta tells the two apart
+            import time as _time
+
+            c0 = tm.compile_count()
+            wall0 = _time.time()
+            t0 = _time.perf_counter()
+            outs = invoke(self._op, inputs, {})
+            dt = _time.perf_counter() - t0
+            name = ("cached_op.compile" if tm.compile_count() > c0
+                    else "cached_op.call")
+            tm.timer(name).record(dt)
+            tm._maybe_span(name, wall0, dt)  # trace timeline lane
+        else:
+            outs = invoke(self._op, inputs, {})
         if not isinstance(outs, tuple):
             outs = (outs,)
         main = outs[: self._n_main]

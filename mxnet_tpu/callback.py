@@ -30,16 +30,42 @@ class Speedometer:
 
     ``auto_reset`` clears the attached eval metric after each report so the
     printed value covers only the last window, not the whole epoch.
+    ``sync=True`` blocks on all pending device work before each clock read,
+    turning the numbers from dispatch throughput into completion throughput
+    (see the module caveat above). When telemetry is enabled the report line
+    carries the window's step accounting (dispatches / recompiles / comm
+    bytes) from ``telemetry.step_report()`` rows.
     """
 
-    def __init__(self, batch_size, frequent=50, auto_reset=True):
+    def __init__(self, batch_size, frequent=50, auto_reset=True, sync=False):
         self.batch_size = batch_size
         self.frequent = frequent
         self.auto_reset = auto_reset
+        self.sync = sync
         self._window_start = None
         self._last_batch = -1
+        self._telemetry_step = 0
+
+    def _telemetry_text(self):
+        from . import telemetry as _tm
+
+        if not _tm.ON:
+            return ""
+        rows = _tm.STEPS.rows_since(self._telemetry_step)
+        if not rows:
+            return ""
+        self._telemetry_step = rows[-1]["step"] + 1
+        disp = sum(r["dispatches"] for r in rows)
+        rec = sum(r["recompiles"] for r in rows)
+        comm = sum(r["comm_bytes"] for r in rows)
+        return (f"\tdispatches={disp}\trecompiles={rec}"
+                f"\tcomm={comm}B")
 
     def __call__(self, param):
+        if self.sync:
+            from . import engine
+
+            engine.wait_all()
         nbatch = param.nbatch
         if nbatch < self._last_batch or self._window_start is None:
             # new epoch (batch counter rewound): restart the clock
@@ -59,6 +85,7 @@ class Speedometer:
             line += _metric_text(param.eval_metric)
             if self.auto_reset:
                 param.eval_metric.reset()
+        line += self._telemetry_text()
         _LOG.info(line)
 
 

@@ -56,15 +56,42 @@ def SummaryWriter(logdir="./logs", **kwargs):
 
 
 class LogMetricsCallback:
-    """Batch-end callback logging EvalMetric values (reference API)."""
+    """Batch-end callback logging EvalMetric values (reference API).
 
-    def __init__(self, logging_dir, prefix=None):
+    With ``log_telemetry=True`` (the default) and ``mx.telemetry`` enabled,
+    each call also writes the latest ``telemetry.step_report()`` row as
+    ``telemetry/*`` scalars — dispatches, recompiles, comm bytes — so the
+    runtime-health curves land next to the accuracy curves.
+    """
+
+    _TELEMETRY_COLS = ("dispatches", "compiles", "recompiles", "comm_bytes",
+                       "kvstore_push_bytes", "kvstore_pull_bytes")
+
+    def __init__(self, logging_dir, prefix=None, log_telemetry=True):
         self.prefix = prefix
         self.step = 0
+        self.log_telemetry = log_telemetry
         self.summary_writer = SummaryWriter(logging_dir)
+
+    def _write_telemetry(self):
+        from .. import telemetry as _tm
+
+        if not _tm.ON:
+            return
+        row = _tm.last_step()
+        if row is None:
+            return
+        for col in self._TELEMETRY_COLS:
+            self.summary_writer.add_scalar(
+                f"telemetry/{col}", row[col], self.step)
+        for tname, secs in row["host_time"].items():
+            self.summary_writer.add_scalar(
+                f"telemetry/host_time/{tname}", secs, self.step)
 
     def __call__(self, param):
         self.step += 1
+        if self.log_telemetry:
+            self._write_telemetry()
         if param.eval_metric is None:
             return
         for name, value in param.eval_metric.get_name_value():

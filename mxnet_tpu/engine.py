@@ -71,8 +71,12 @@ def wait_all() -> None:
     try:
         for dev in jax.local_devices():  # only addressable devices
             jax.device_put(0, dev).block_until_ready()
-    except Exception as e:  # noqa: BLE001
-        raise MXNetError(str(e)) from e
+    except MXNetError:
+        raise
+    except Exception as e:  # noqa: BLE001 — normalize XLA/PJRT errors
+        from .error import _normalize
+
+        raise _normalize(str(e)) from e
 
 
 _BULK_SIZE = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))

@@ -215,6 +215,9 @@ class DataLoader:
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        return self._iter()
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._load_batch(indices)
@@ -223,6 +226,33 @@ class DataLoader:
             yield from self._threaded_iter()
         else:
             yield from self._process_iter()
+
+    def _iter(self):
+        """Telemetry shim: when enabled, time how long the consumer waits
+        for each batch (prefetch-hit ≈ 0; a large latency means the input
+        pipeline, not the accelerator, is the bottleneck)."""
+        from ... import telemetry as _tm
+
+        inner = self._iter_impl()
+        if not _tm.ON:
+            yield from inner
+            return
+        import time as _time
+
+        t = _tm.timer("dataloader.batch")
+        n = _tm.counter("dataloader.batches")
+        while True:
+            wall0 = _time.time()
+            t0 = _time.perf_counter()
+            try:
+                batch = next(inner)
+            except StopIteration:
+                return
+            dt = _time.perf_counter() - t0
+            t.record(dt)
+            _tm._maybe_span("dataloader.batch", wall0, dt)
+            n.inc()
+            yield batch
 
     def _ensure_pool(self):
         """Spawn the persistent worker pool once; reused across epochs (the

@@ -20,6 +20,7 @@ from ..base import MXNetError
 from .parameter import Parameter
 from .. import optimizer as opt_mod
 from .. import kvstore as kvs_mod
+from .. import telemetry as _telemetry
 
 __all__ = ["Trainer"]
 
@@ -137,10 +138,16 @@ class Trainer:
                 weights.append(p.data())
             if keys:
                 self._kvstore.pushpull(keys, grads, out=weights)
+            if _telemetry.ON:
+                _telemetry.mark_step()
             return
         if self._kvstore is not None and self._kvstore.num_workers > 1:
             self.allreduce_grads()
         self._update(ignore_stale_grad)
+        if _telemetry.ON:
+            # close one telemetry accounting row per optimization step —
+            # the substrate of telemetry.step_report()
+            _telemetry.mark_step()
 
     def _update(self, ignore_stale_grad=False):
         active = []
@@ -229,8 +236,15 @@ class Trainer:
 
             def multi_step(ws, ss, gs, lrs, wds, ts, rs):
                 # body executes at TRACE time only — the counter observes
-                # recompiles, and the Python loop unrolls into one program
+                # recompiles, and the Python loop unrolls into one program.
+                # _fused_traces (PR 1's private counter) is kept for direct
+                # assertions; the telemetry watchdog is the user-facing
+                # surface: a re-trace of this program after warmup means a
+                # parameter signature changed mid-run and warns loudly
                 self._fused_traces += 1
+                _telemetry.record_compile(
+                    "trainer.fused_step", (ws, gs),
+                    attrs=f"n_params={len(ws)} dtype={key[0]}")
                 new_ws = [None] * len(ws)
                 new_ss = [None] * len(ws)
                 for k in range(len(ws)):
@@ -295,6 +309,10 @@ class Trainer:
         wds = onp.asarray([opt._get_wd(i) for i in idxs], onp.float32)
         rs = onp.float32(opt.rescale_grad)
         self._fused_dispatches += 1
+        if _telemetry.ON:
+            # fused buckets bypass the invoke() chokepoint — count the
+            # compiled-program call here so step rows stay truthful
+            _telemetry.record_dispatch()
         new_ws, new_ss = fused(ws, ss, gs, lrs, wds, ts, rs)
         for k, i in enumerate(idxs):
             self._params[i].data()._set_data(new_ws[k])
@@ -305,6 +323,8 @@ class Trainer:
         """Apply updates without allreduce (manual grad management)."""
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
+        if _telemetry.ON:
+            _telemetry.mark_step()
 
     # -- checkpoint ---------------------------------------------------------
     def save_states(self, fname):

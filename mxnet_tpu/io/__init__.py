@@ -37,11 +37,42 @@ class DataBatch:
         return f"DataBatch: data shapes: {shapes} pad: {self.pad}"
 
 
+def _timed_next(nxt):
+    """Wrap a ``__next__`` with the telemetry batch-latency timer (one bool
+    test per batch when telemetry is off; per-class timer names feed the
+    step report's host-time breakdown)."""
+    import functools
+    import time as _time
+
+    from .. import telemetry as _tm
+
+    @functools.wraps(nxt)
+    def timed(self):
+        if not _tm.ON:
+            return nxt(self)
+        t0 = _time.perf_counter()
+        batch = nxt(self)  # StopIteration propagates untimed
+        _tm.timer(f"io.{type(self).__name__}.batch").record(
+            _time.perf_counter() - t0)
+        return batch
+
+    timed._telemetry_wrapped = True
+    return timed
+
+
 class DataIter:
     """Iterator base (reference: io.py DataIter:179)."""
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
+
+    def __init_subclass__(cls, **kwargs):
+        # every concrete iterator gets the batch timer, whether it uses the
+        # base __next__ or overrides it
+        super().__init_subclass__(**kwargs)
+        nxt = cls.__dict__.get("__next__")
+        if nxt is not None and not getattr(nxt, "_telemetry_wrapped", False):
+            cls.__next__ = _timed_next(nxt)
 
     def __iter__(self):
         return self
@@ -78,6 +109,11 @@ class DataIter:
 
     def getpad(self):
         return 0
+
+
+# the base __next__ serves every iterator that doesn't override it
+# (NDArrayIter et al.); wrap it once so those are timed too
+DataIter.__next__ = _timed_next(DataIter.__next__)
 
 
 class NDArrayIter(DataIter):

@@ -26,12 +26,29 @@ import pickle
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase
+from .. import telemetry as _telemetry
 
 __all__ = ["KVStore", "create"]
 
 
 def _as_list(x):
     return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _nbytes(values):
+    """Total payload bytes of a (nested list of) dense/row-sparse arrays —
+    the quantity the telemetry comm counters account per push/pull."""
+    total = 0
+    for v in values:
+        if isinstance(v, (list, tuple)):
+            total += _nbytes(v)
+            continue
+        data = getattr(v, "_data", None)
+        if data is not None:               # dense NDArray
+            total += data.nbytes
+        elif hasattr(v, "data") and hasattr(v, "indices"):  # row-sparse
+            total += v.data._data.nbytes + v.indices._data.nbytes
+    return total
 
 
 def _keys_vals(key, value):
@@ -143,6 +160,8 @@ class KVStore(KVStoreBase):
         from ..ndarray.sparse import RowSparseNDArray
 
         keys, vals = _keys_vals(key, value)
+        if _telemetry.ON:
+            _telemetry.record_comm(push_bytes=_nbytes(vals))
         # row_sparse pushes stay sparse end-to-end in-process: merged rows
         # go straight to the optimizer's lazy _apply_sparse path — the
         # embedding-gradient flow (reference: sparse FComputeEx update
@@ -203,6 +222,8 @@ class KVStore(KVStoreBase):
             src = self._store[k]
             for dst in _as_list(o):
                 dst._set_data(src.as_in_ctx(dst.ctx)._data)
+        if _telemetry.ON:
+            _telemetry.record_comm(pull_bytes=_nbytes(outs))
 
     def pushpull(self, key, value, out=None, priority=0):
         """Fused allreduce (reference: kvstore.h:237 PushPull). Multi-key
@@ -210,6 +231,10 @@ class KVStore(KVStoreBase):
         key — Trainer batches its whole parameter list into a single call."""
         keys, vals = _keys_vals(key, value)
         outs = [None] * len(keys) if out is None else _keys_vals(key, out)[1]
+        if _telemetry.ON:
+            _telemetry.record_comm(
+                push_bytes=_nbytes(vals),
+                pull_bytes=0 if out is None else _nbytes(outs))
         reds = self._global_reduce_many(
             [self._reduce(v, key=k) for k, v in zip(keys, vals)])
         for k, red, o in zip(keys, reds, outs):
@@ -269,6 +294,9 @@ class KVStore(KVStoreBase):
                 dst.indices._set_data(rid)
                 dst.data._set_data(vals)
                 dst._shape = tuple(table.shape)
+                if _telemetry.ON:
+                    _telemetry.record_comm(
+                        pull_bytes=vals.nbytes + rid.nbytes)
 
     # -- optimizer-on-store (reference: update_on_kvstore) -------------------
     def set_optimizer(self, optimizer):

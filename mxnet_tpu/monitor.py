@@ -1,0 +1,11 @@
+"""mx.monitor — reference-parity surface (python/mxnet/monitor.py).
+
+The implementation lives in the telemetry layer (its stats feed the same
+event log as the rest of the runtime); this module keeps the reference
+import path ``mx.monitor.Monitor`` working.
+"""
+from __future__ import annotations
+
+from .telemetry.monitor import Monitor
+
+__all__ = ["Monitor"]

@@ -97,12 +97,28 @@ class Op:
                 # and jax.vjp linearizes against one cached pjit primitive
                 # instead of re-tracing op internals (e.g. RNN scans) every
                 # step — the per-op program cache of SURVEY §7
-                f = jax.jit(f)
+                f = jax.jit(_observe_compiles(f, f"op:{self.name}", key))
             self._fn_cache[key] = f
         return f
 
     def __repr__(self):
         return f"Op({self.name})"
+
+
+def _observe_compiles(f, site, attrs_key):
+    """Wrap ``f`` (pre-jit) so the telemetry recompile watchdog sees every
+    trace. The wrapper body runs ONLY at trace time — cached calls execute
+    the compiled program directly — so per-call overhead is zero and the
+    trace-time report short-circuits on telemetry.ON."""
+    from .. import telemetry as _telemetry
+
+    attrs_repr = repr(attrs_key) if attrs_key else None
+
+    def observed(*args):
+        _telemetry.record_compile(site, args, attrs_repr)
+        return f(*args)
+
+    return observed
 
 
 def register(name, make_fn=None, *, needs_rng=False, nout=1,
@@ -176,9 +192,10 @@ def _hot():
         from .. import _deferred_compute as dc
         from .. import amp as _amp
         from .. import engine
+        from .. import telemetry
 
         ensure_backend()
-        mods = _hot_mods["m"] = (NDArray, ag, dc, _amp, engine)
+        mods = _hot_mods["m"] = (NDArray, ag, dc, _amp, engine, telemetry)
     return mods
 
 
@@ -188,8 +205,12 @@ def invoke(op: Op, inputs, attrs=None, out=None):
     Mirrors Imperative::Invoke (imperative.cc:98): resolve kernel, execute
     (async via XLA), record autograd tape / deferred-compute graph as needed.
     """
-    NDArray, ag, dc, _amp, engine = _hot()
+    NDArray, ag, dc, _amp, engine, _telemetry = _hot()
 
+    if _telemetry.ON:
+        # per-step dispatch accounting (telemetry.step_report); one bool
+        # test when telemetry is off — invoke is THE dispatch chokepoint
+        _telemetry.record_dispatch()
     attrs = attrs or {}
     if _amp.is_enabled() and op.name in _amp.MXU_OPS and \
             "__amp__" not in attrs:

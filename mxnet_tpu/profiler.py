@@ -68,8 +68,14 @@ def resume(profile_process="worker"):
 
 
 def dump(finished=True, profile_process="worker"):
+    """Stop any running trace and write the aggregate table to
+    ``_config["filename"]`` (reference: dump writes the chrome trace to the
+    configured file; here the host/device aggregate table is the artifact —
+    the xplane trace lives in ``trace_dir``)."""
     if _running:
         stop()
+    with open(_config["filename"], "w") as f:
+        f.write(dumps() + "\n")
 
 
 # -- xplane → per-op aggregate stats (reference: aggregate_stats.cc) --------
@@ -198,12 +204,16 @@ def scope(name="<unk>"):
     track = _config.get("profile_memory")
     if track:
         before = {id(a) for a in jax.live_arrays()}
+    wall0 = time.time()
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
     dt = time.perf_counter() - t0
     tot, cnt = _ranges.get(name, (0.0, 0))
     _ranges[name] = (tot + dt, cnt + 1)
+    from . import telemetry as _telemetry
+
+    _telemetry._maybe_span("profiler." + name, wall0, dt)
     if track:
         live_now = jax.live_arrays()
         # prune attributions of freed buffers every scope exit — id() values

@@ -1,0 +1,199 @@
+"""mxnet_tpu.telemetry — process-wide runtime observability.
+
+A thread-safe registry of counters/gauges/timers, a bounded structured
+event log (JSONL + chrome://tracing export merging profiler host spans),
+a per-step accountant (``step_report()``), and a recompile watchdog over
+every jit compile site (``Op`` fns, ``CachedOp`` programs, the fused
+``Trainer.step``). See docs/DESIGN.md "Observability".
+
+Gating: ``MXNET_TELEMETRY=1`` in the environment or ``telemetry.enable()``.
+The contract when OFF is near-zero overhead: every instrumentation site in
+the hot paths guards on the module-level ``ON`` bool (one attribute read),
+and the compile observers live INSIDE jitted function bodies, so they cost
+nothing per call — only per trace, and even then they short-circuit on
+``ON``.
+
+Typical use::
+
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    ...train...
+    for row in telemetry.step_report():
+        print(row["step"], row["dispatches"], row["recompiles"],
+              row["comm_bytes"], row["host_time"])
+    telemetry.dump_events("events.jsonl")
+    telemetry.export_chrome_trace("trace.json")
+"""
+from __future__ import annotations
+
+import os
+
+from .events import EventLog
+from .registry import Counter, Gauge, Registry, Timer
+from .step import StepTracker
+from .watchdog import Watchdog, format_signature
+from .monitor import Monitor
+
+__all__ = ["enable", "disable", "is_enabled", "configure", "reset",
+           "counter", "gauge", "timer", "metrics", "event", "events",
+           "dump_events", "export_chrome_trace", "mark_step",
+           "step_report", "last_step", "watchdog_stats", "Monitor",
+           "Counter", "Gauge", "Timer", "Registry", "format_signature"]
+
+# THE gate. Instrumentation sites read this module attribute directly
+# (``if _telemetry.ON:``) — rebinding a module-level bool is the cheapest
+# toggle Python offers short of code patching.
+ON = False
+
+REGISTRY = Registry()
+EVENTS = EventLog()
+WATCHDOG = Watchdog(warmup_steps=1)
+STEPS = StepTracker(REGISTRY)
+
+# pre-resolved hot metrics: the dispatch chokepoint and the byte counters
+# must not pay a dict lookup per call
+_C_DISPATCH = REGISTRY.counter("ops.dispatches")
+_C_COMPILES = REGISTRY.counter("jit.compiles")
+_C_RECOMPILES = REGISTRY.counter("jit.recompiles")
+_C_PUSH_BYTES = REGISTRY.counter("kvstore.push_bytes")
+_C_PULL_BYTES = REGISTRY.counter("kvstore.pull_bytes")
+
+
+# -- gating -----------------------------------------------------------------
+def enable():
+    """Turn telemetry on process-wide (idempotent)."""
+    global ON
+    ON = True
+
+
+def disable():
+    global ON
+    ON = False
+
+
+def is_enabled():
+    return ON
+
+
+def configure(watchdog_warmup_steps=None, max_events=None):
+    """Tune the layer. ``watchdog_warmup_steps``: marked steps before the
+    watchdog arms (0 = warn on any recompile immediately). ``max_events``:
+    rebound the event buffer (drops existing events)."""
+    global EVENTS
+    if watchdog_warmup_steps is not None:
+        WATCHDOG.warmup_steps = int(watchdog_warmup_steps)
+    if max_events is not None:
+        EVENTS = EventLog(maxlen=int(max_events))
+
+
+def reset():
+    """Zero all metrics, events, step rows and watchdog state (metric
+    objects stay valid — hot sites hold direct references)."""
+    REGISTRY.reset()
+    EVENTS.clear()
+    STEPS.reset()
+    WATCHDOG.reset()
+
+
+# -- metric access ----------------------------------------------------------
+def counter(name) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def timer(name) -> Timer:
+    return REGISTRY.timer(name)
+
+
+def metrics() -> dict:
+    """Plain-value snapshot of every metric."""
+    return REGISTRY.snapshot()
+
+
+# -- events -----------------------------------------------------------------
+def event(name, kind="instant", **fields):
+    if ON:
+        EVENTS.emit(name, kind=kind, **fields)
+
+
+def events():
+    return EVENTS.events()
+
+
+def dump_events(path):
+    """Write the event buffer as JSONL; returns the number of lines."""
+    return EVENTS.dump_jsonl(path)
+
+
+def export_chrome_trace(path, merge_profiler=True):
+    """Write a chrome://tracing JSON (load in Perfetto / chrome://tracing);
+    merges profiler._ranges aggregate host spans unless told otherwise."""
+    return EVENTS.export_chrome_trace(path, merge_profiler=merge_profiler)
+
+
+def _maybe_span(name, wall_ts, dur):
+    """Timer.time() callback — module-level so registry.py can import it
+    lazily without a cycle."""
+    if ON:
+        EVENTS.emit(name, kind="span", ts=wall_ts, dur=dur)
+
+
+# -- steps ------------------------------------------------------------------
+def mark_step(name=None):
+    """Close one accounting step (no-op when disabled). Trainer calls this
+    at the end of every ``step()``/``update()``."""
+    if not ON:
+        return None
+    return STEPS.mark_step(name, event_log=EVENTS)
+
+
+def step_report(reset=False):
+    """One dict per marked step: {step, dispatches, compiles, recompiles,
+    comm_bytes, kvstore_push_bytes, kvstore_pull_bytes, host_time: {...}}."""
+    return STEPS.report(reset=reset)
+
+
+def last_step():
+    return STEPS.last()
+
+
+# -- compile observation (called from INSIDE traced bodies) -----------------
+def record_compile(site, args=None, attrs=None, sig=None):
+    """Report a jit trace at ``site``. Executes only at trace time (the
+    callers embed this in the traced function body); checks ``ON`` first so
+    disabled-mode traces cost one bool test."""
+    if not ON:
+        return
+    if sig is None:
+        sig = format_signature(args if args is not None else (), attrs)
+    WATCHDOG.record_compile(site, sig, STEPS.steps_marked,
+                            _C_COMPILES, _C_RECOMPILES, event_log=EVENTS)
+
+
+def record_dispatch(n=1):
+    """Count a compute dispatch (callers guard on ``telemetry.ON``)."""
+    _C_DISPATCH.inc(n)
+
+
+def record_comm(push_bytes=0, pull_bytes=0):
+    """Count kvstore traffic (callers guard on ``telemetry.ON``)."""
+    if push_bytes:
+        _C_PUSH_BYTES.inc(push_bytes)
+    if pull_bytes:
+        _C_PULL_BYTES.inc(pull_bytes)
+
+
+def compile_count():
+    return _C_COMPILES.value
+
+
+def watchdog_stats():
+    """Per-site compile/signature counts the watchdog has observed."""
+    return WATCHDOG.site_stats()
+
+
+if os.environ.get("MXNET_TELEMETRY", "").lower() in ("1", "true", "on"):
+    enable()

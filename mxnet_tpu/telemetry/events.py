@@ -1,0 +1,137 @@
+"""Structured event log: bounded in-memory buffer, JSONL sink,
+chrome://tracing export.
+
+Events are plain dicts ``{"name", "kind", "ts", ...}`` with ``ts`` in
+epoch seconds. ``kind`` is one of:
+
+- ``span``    — has ``dur`` (seconds): a timed host region (Timer.time(),
+  profiler.scope, CachedOp calls);
+- ``instant`` — a point event (watchdog warnings, step marks);
+- ``counter`` — a sampled value (step-report rows re-emitted as events).
+
+The buffer is a deque bounded by ``MXNET_TELEMETRY_MAX_EVENTS`` (default
+100k): a week-long training run cannot OOM the host through its own
+telemetry. ``export_chrome_trace`` merges ``profiler._ranges`` aggregate
+host spans so one Perfetto view covers both layers (PyGraph's lesson:
+the capture-layer and host-layer timelines must be inspectable together).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+__all__ = ["EventLog"]
+
+
+class EventLog:
+    def __init__(self, maxlen=None):
+        if maxlen is None:
+            maxlen = int(os.environ.get("MXNET_TELEMETRY_MAX_EVENTS",
+                                        100_000))
+        self._events = collections.deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self._dropped = 0
+
+    def emit(self, name, kind="instant", ts=None, dur=None, **fields):
+        ev = {"name": name, "kind": kind,
+              "ts": time.time() if ts is None else ts}
+        if dur is not None:
+            ev["dur"] = dur
+        if fields:
+            ev.update(fields)
+        with self._lock:
+            if len(self._events) == self._events.maxlen:
+                self._dropped += 1
+            self._events.append(ev)
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self):
+        return self._dropped
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self._dropped = 0
+
+    # -- sinks ---------------------------------------------------------------
+    def dump_jsonl(self, path):
+        """One JSON object per line; append-safe for external tailers."""
+        evs = self.events()
+        with open(path, "w") as f:
+            for ev in evs:
+                f.write(json.dumps(ev) + "\n")
+        return len(evs)
+
+    def export_chrome_trace(self, path, merge_profiler=True):
+        """Write a chrome://tracing / Perfetto JSON trace.
+
+        Span events become ``ph:"X"`` complete events on a per-category
+        lane (category = name up to the first dot). With
+        ``merge_profiler=True``, host ranges aggregated in
+        ``profiler._ranges`` that never went through the event log are
+        appended on a ``profiler.aggregate`` lane as back-to-back synthetic
+        spans carrying call counts — aggregates have no timestamps, so the
+        lane shows magnitude, not placement.
+        """
+        evs = self.events()
+        base = min((e["ts"] for e in evs), default=time.time())
+        tids = {}
+
+        def tid_of(name):
+            cat = name.split(".", 1)[0]
+            return tids.setdefault(cat, len(tids) + 1)
+
+        trace = []
+        for ev in evs:
+            ts_us = (ev["ts"] - base) * 1e6
+            args = {k: v for k, v in ev.items()
+                    if k not in ("name", "kind", "ts", "dur")}
+            if ev["kind"] == "span":
+                trace.append({"name": ev["name"], "ph": "X", "pid": 0,
+                              "tid": tid_of(ev["name"]),
+                              "ts": ts_us, "dur": ev.get("dur", 0.0) * 1e6,
+                              "args": args})
+            elif ev["kind"] == "counter":
+                trace.append({"name": ev["name"], "ph": "C", "pid": 0,
+                              "tid": tid_of(ev["name"]), "ts": ts_us,
+                              "args": args})
+            else:
+                trace.append({"name": ev["name"], "ph": "i", "pid": 0,
+                              "tid": tid_of(ev["name"]), "ts": ts_us,
+                              "s": "g", "args": args})
+        if merge_profiler:
+            try:
+                from .. import profiler as _prof
+
+                ranges = dict(_prof._ranges)
+            except Exception:  # noqa: BLE001 — profiler optional here
+                ranges = {}
+            off = 0.0
+            agg_tid = len(tids) + 1
+            for name, (total_s, count) in sorted(ranges.items()):
+                trace.append({"name": name, "ph": "X", "pid": 0,
+                              "tid": agg_tid, "ts": off,
+                              "dur": total_s * 1e6,
+                              "args": {"calls": count,
+                                       "avg_ms": total_s * 1e3 /
+                                       max(count, 1),
+                                       "aggregate": True}})
+                off += total_s * 1e6
+            if ranges:
+                trace.append({"ph": "M", "pid": 0, "tid": agg_tid,
+                              "name": "thread_name",
+                              "args": {"name": "profiler.aggregate"}})
+        for cat, tid in tids.items():
+            trace.append({"ph": "M", "pid": 0, "tid": tid,
+                          "name": "thread_name", "args": {"name": cat}})
+        with open(path, "w") as f:
+            json.dump({"traceEvents": trace,
+                       "displayTimeUnit": "ms"}, f)
+        return len(trace)

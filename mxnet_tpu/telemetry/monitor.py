@@ -1,0 +1,148 @@
+"""Monitor: log per-layer tensor statistics during training.
+
+Reference parity: ``python/mxnet/monitor.py`` (Monitor(interval,
+stat_func, pattern, sort) / install / tic / toc / toc_print), re-expressed
+for gluon — ``install(block)`` walks the Block tree and registers forward
+hooks via ``Block.register_forward_hook``, so every monitored layer's
+outputs are captured as they are produced.
+
+Works on EAGER forwards: a hybridized HybridBlock replays a compiled
+program and never runs Python hooks (same limitation family as the
+reference, whose Monitor required ``install`` on an executor). Monitor
+therefore logs loudly if it observes nothing between tic() and toc() on
+a block that is hybridized.
+
+Stats are computed lazily at ``toc()`` — the hook only queues array
+handles, so monitoring never forces a device sync inside the forward.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import re
+
+__all__ = ["Monitor"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+
+def _default_stat(arr):
+    """|x|_2 / sqrt(size) — the reference's default 'norm' stat."""
+    import jax.numpy as jnp
+
+    x = arr.astype(jnp.float32)
+    return jnp.sqrt((x * x).sum()) / math.sqrt(max(int(x.size), 1))
+
+
+class Monitor:
+    """Collect activation statistics every ``interval`` batches.
+
+    Parameters mirror the reference: ``stat_func`` maps a raw array to a
+    scalar (default: norm/sqrt(size)); ``pattern`` filters monitored
+    names; ``sort`` orders toc() results by name.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False,
+                 monitor_all=False):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.monitor_all = monitor_all  # also capture inputs
+        self.step = 0
+        self.activated = False
+        self.queue = []          # (step, name, raw array)
+        self._installed = []     # (block, hook) for uninstall
+
+    # -- installation --------------------------------------------------------
+    def _walk(self, block, prefix):
+        yield prefix, block
+        for name, child in getattr(block, "_children", {}).items():
+            yield from self._walk(child, f"{prefix}.{name}")
+
+    def install(self, block, name=None):
+        """Register forward hooks on ``block`` and every descendant."""
+        root = name or type(block).__name__
+        for path, b in self._walk(block, root):
+            hook = self._make_hook(path)
+            b.register_forward_hook(hook)
+            self._installed.append((b, hook))
+        return self
+
+    def uninstall(self):
+        for b, hook in self._installed:
+            try:
+                b._forward_hooks.remove(hook)
+            except ValueError:
+                pass
+        self._installed = []
+
+    def _make_hook(self, path):
+        def hook(block, inputs, outputs):
+            if not self.activated:
+                return
+            items = []
+            if self.monitor_all:
+                items += [(f"{path}_input{i}", a)
+                          for i, a in enumerate(self._flat(inputs))]
+            items += [(f"{path}_output{i}", a)
+                      for i, a in enumerate(self._flat(outputs))]
+            for nm, arr in items:
+                if self.re_pattern.match(nm):
+                    self.queue.append((self.step, nm, arr))
+
+        return hook
+
+    @staticmethod
+    def _flat(out):
+        from ..ndarray.ndarray import NDArray
+
+        if isinstance(out, NDArray):
+            return [out._data]
+        if isinstance(out, (tuple, list)):
+            flat = []
+            for o in out:
+                flat.extend(Monitor._flat(o))
+            return flat
+        return []
+
+    # -- collection (reference: monitor.py tic/toc/toc_print) ----------------
+    def tic(self):
+        """Start collecting if this batch is on the interval."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Stop collecting; compute queued stats. Returns
+        [(step, name, value_str)] like the reference."""
+        if not self.activated:
+            return []
+        self.activated = False
+        if not self.queue and self._installed:
+            _LOG.warning(
+                "Monitor observed no forward activity between tic() and "
+                "toc() — hybridized blocks replay compiled programs and "
+                "skip Python hooks; monitor an un-hybridized net")
+        res = []
+        for step, name, arr in self.queue:
+            try:
+                val = float(self.stat_func(arr))
+            except Exception as e:  # noqa: BLE001 — one bad stat ≠ dead run
+                val = float("nan")
+                _LOG.warning("Monitor stat_func failed on %s: %s", name, e)
+            res.append((step, name, f"{val:.8g}"))
+        self.queue = []
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        from . import event
+
+        for step, name, val in res:
+            event("monitor.stat", kind="counter", step=step,
+                  tensor=name, value=val)
+        return res
+
+    def toc_print(self):
+        for step, name, val in self.toc():
+            _LOG.info("Batch: %7d %30s %s", step, name, val)

@@ -1,0 +1,175 @@
+"""Thread-safe metric registry: counters, gauges, timers.
+
+The substrate of the telemetry layer (ISSUE 2; TVM's per-op cost telemetry
+is the design precedent — every later optimization PR measures against
+these numbers). Metric objects are created once and kept for the process
+lifetime: hot instrumentation sites resolve a Counter a single time and
+call ``inc()`` on it, so the enabled-path cost is one lock + one add.
+``reset()`` zeroes values in place rather than dropping objects, so
+pre-resolved references held by the hot paths never go stale.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+__all__ = ["Counter", "Gauge", "Timer", "Registry"]
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is atomic under an internal lock —
+    CPython's ``+=`` on an attribute is NOT atomic (read/add/store can
+    interleave across threads), and DataLoader worker threads do hit the
+    same counters concurrently."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = 0
+
+    def __repr__(self):
+        return f"Counter({self.name}={self._value})"
+
+
+class Gauge:
+    """Last-write-wins scalar (e.g. queue depth, live bytes)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self):
+        return self._value
+
+    def reset(self):
+        with self._lock:
+            self._value = 0.0
+
+    def __repr__(self):
+        return f"Gauge({self.name}={self._value})"
+
+
+class Timer:
+    """Accumulating duration metric: (total seconds, count)."""
+
+    __slots__ = ("name", "_total", "_count", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._total = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def record(self, seconds):
+        with self._lock:
+            self._total += seconds
+            self._count += 1
+
+    @contextlib.contextmanager
+    def time(self):
+        """Time a block; also emits a span event when the event log is on."""
+        from . import _maybe_span
+
+        wall0 = time.time()
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            dt = time.perf_counter() - t0
+            self.record(dt)
+            _maybe_span(self.name, wall0, dt)
+
+    @property
+    def total(self):
+        return self._total
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def value(self):
+        return (self._total, self._count)
+
+    def reset(self):
+        with self._lock:
+            self._total = 0.0
+            self._count = 0
+
+    def __repr__(self):
+        return f"Timer({self.name}: {self._total:.6f}s/{self._count})"
+
+
+class Registry:
+    """Process-wide name -> metric map. Creation is locked; lookups of an
+    existing metric are a plain dict get (readers never block writers for
+    long — the registry is small and append-mostly)."""
+
+    def __init__(self):
+        self._metrics: dict = {}
+        self._lock = threading.Lock()
+        # bumped on every metric creation; lets per-step accounting cache
+        # resolved metric objects and refresh only when the set grows
+        self.version = 0
+
+    def _get(self, name, cls):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name)
+                    self._metrics[name] = m
+                    self.version += 1
+        if not isinstance(m, cls):
+            from ..base import MXNetError
+
+            raise MXNetError(
+                f"telemetry metric {name!r} already registered as "
+                f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name) -> Gauge:
+        return self._get(name, Gauge)
+
+    def timer(self, name) -> Timer:
+        return self._get(name, Timer)
+
+    def snapshot(self) -> dict:
+        """Plain-value view: {name: int|float|(total, count)}."""
+        return {name: m.value for name, m in sorted(self._metrics.items())}
+
+    def reset(self):
+        """Zero every metric IN PLACE (objects stay valid — hot sites hold
+        direct references)."""
+        for m in list(self._metrics.values()):
+            m.reset()
+
+    def __iter__(self):
+        return iter(list(self._metrics.values()))

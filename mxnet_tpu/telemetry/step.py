@@ -1,0 +1,112 @@
+"""Per-step accounting: snapshot counter deltas at step boundaries.
+
+``Trainer.step``/``Trainer.update`` call ``mark_step()`` when telemetry is
+on; each call closes one row answering "what did step N cost": dispatches,
+compiles/recompiles, kvstore comm bytes, and a host-time breakdown (every
+timer's delta). ``step_report()`` returns the accumulated rows — the
+substrate Speedometer and the tensorboard callback consume.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+__all__ = ["StepTracker"]
+
+# counters surfaced as first-class row columns; everything else lands in
+# the host_time breakdown (timers) or is ignored (gauges are samples, not
+# flows — deltas are meaningless for them)
+_ROW_COUNTERS = {
+    "dispatches": "ops.dispatches",
+    "compiles": "jit.compiles",
+    "recompiles": "jit.recompiles",
+    "kvstore_push_bytes": "kvstore.push_bytes",
+    "kvstore_pull_bytes": "kvstore.pull_bytes",
+}
+
+_MAX_ROWS = 100_000  # bound memory over arbitrarily long runs
+
+
+class StepTracker:
+    def __init__(self, registry):
+        self._registry = registry
+        self._rows = collections.deque(maxlen=_MAX_ROWS)
+        self._lock = threading.Lock()
+        self._prev = {}
+        self._steps = 0
+        # resolved metric objects, refreshed only when the registry grows
+        # (version bump) — mark_step sits on the Trainer.step hot path and
+        # must not walk/isinstance the whole registry every step
+        self._cols = []
+        self._timers = []
+        self._seen_version = -1
+
+    @property
+    def steps_marked(self):
+        return self._steps
+
+    def _refresh_cache(self):
+        from .registry import Timer
+
+        reg = self._registry
+        # resolving the row counters creates any missing ones (bumping
+        # version), so read the version AFTER
+        self._cols = [(col, reg.counter(cname))
+                      for col, cname in _ROW_COUNTERS.items()]
+        self._timers = [m for m in reg if isinstance(m, Timer)]
+        self._seen_version = reg.version
+
+    def mark_step(self, name=None, event_log=None):
+        with self._lock:
+            if self._seen_version != self._registry.version:
+                self._refresh_cache()
+            prev = self._prev
+            row = {"step": self._steps,
+                   "name": name or f"step{self._steps}",
+                   "wall_time": time.time()}
+            for col, c in self._cols:
+                v = c._value  # GIL-atomic int read; no per-metric lock
+                row[col] = v - prev.get(col, 0)
+                prev[col] = v
+            row["comm_bytes"] = (row["kvstore_push_bytes"] +
+                                 row["kvstore_pull_bytes"])
+            host = {}
+            for t in self._timers:
+                tot = t._total
+                key = "t:" + t.name
+                d = tot - prev.get(key, 0.0)
+                if d > 0.0:
+                    host[t.name] = d
+                prev[key] = tot
+            row["host_time"] = host
+            self._rows.append(row)
+            self._steps += 1
+        if event_log is not None:
+            event_log.emit("step", kind="counter", ts=row["wall_time"],
+                           step_name=row["name"],
+                           **{k: v for k, v in row.items()
+                              if k not in ("wall_time", "host_time", "name")})
+        return row
+
+    def report(self, reset=False):
+        with self._lock:
+            rows = list(self._rows)
+            if reset:
+                self._rows.clear()
+        return rows
+
+    def last(self):
+        with self._lock:
+            return self._rows[-1] if self._rows else None
+
+    def rows_since(self, idx):
+        """Rows with row["step"] >= idx (window aggregation for callbacks)."""
+        with self._lock:
+            return [r for r in self._rows if r["step"] >= idx]
+
+    def reset(self):
+        with self._lock:
+            self._rows.clear()
+            self._prev = {}
+            self._steps = 0

@@ -1,0 +1,119 @@
+"""Recompile watchdog: detect silent jit-cache-miss storms.
+
+PyGraph (arxiv 2503.19779) showed that graph-capture runtimes degrade
+silently when something keeps invalidating the compiled-program cache — a
+shape that drifts, a hyperparameter baked into a trace, a train-flag flip.
+Here every compile site (``Op`` fns, ``CachedOp`` programs, the fused
+``Trainer.step``) reports trace-time entry to this module (the wrapper
+body only executes when jax actually traces, so a report IS a compile).
+
+Semantics:
+
+- every compile increments ``jit.compiles``; a compile at a site that has
+  already compiled at least once increments ``jit.recompiles``;
+- a recompile observed AFTER the warmup window (``warmup_steps`` marked
+  steps, default 1 — the first step legitimately compiles everything)
+  logs ONE WARNING carrying the site, the offending shape/dtype/hyper
+  signature and the site's distinct-signature history, and emits an
+  ``instant`` event so the trace timeline shows where the storm started.
+
+The watchdog holds no jax state and never touches the jit cache — it
+mirrors it from the outside, which is why disabled-mode overhead is zero
+(reports are short-circuited on the module flag before any work).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+
+__all__ = ["Watchdog", "format_signature"]
+
+_LOG = logging.getLogger("mxnet_tpu.telemetry")
+
+# cap per-site signature history: the point is the warning, not an
+# unbounded shadow of the jit cache
+_MAX_SIGS_KEPT = 64
+
+
+def format_signature(args, attrs=None, max_leaves=24):
+    """Compact "f32[8,128],i32[8]" signature from (possibly traced) args.
+
+    Works on tracers at trace time — only ``shape``/``dtype`` are read,
+    never values. ``attrs`` (static hypers) are appended verbatim so a
+    hyperparameter smuggled in as a static attr shows up in the warning.
+    """
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(args)
+    except Exception:  # noqa: BLE001 — never let telemetry break a trace
+        leaves = list(args) if isinstance(args, (list, tuple)) else [args]
+    parts = []
+    for x in leaves[:max_leaves]:
+        dt = getattr(x, "dtype", None)
+        shp = getattr(x, "shape", None)
+        if dt is None or shp is None:
+            parts.append(type(x).__name__)
+            continue
+        name = getattr(dt, "name", str(dt))
+        short = {"float32": "f32", "float64": "f64", "float16": "f16",
+                 "bfloat16": "bf16", "int32": "i32", "int64": "i64",
+                 "int8": "i8", "uint8": "u8", "bool": "b1"}.get(name, name)
+        parts.append(f"{short}[{','.join(map(str, shp))}]")
+    if len(leaves) > max_leaves:
+        parts.append(f"…+{len(leaves) - max_leaves}")
+    sig = ",".join(parts)
+    if attrs:
+        sig += f" attrs={attrs}"
+    return sig
+
+
+class Watchdog:
+    def __init__(self, warmup_steps=1):
+        self.warmup_steps = warmup_steps
+        self._sites: dict = {}  # site -> {"compiles": int, "sigs": list}
+        self._lock = threading.Lock()
+        self.warnings_fired = 0
+
+    def reset(self):
+        with self._lock:
+            self._sites.clear()
+            self.warnings_fired = 0
+
+    def record_compile(self, site, sig, steps_marked, compile_counter,
+                       recompile_counter, event_log=None):
+        """Called from INSIDE a traced function body (trace time only)."""
+        compile_counter.inc()
+        with self._lock:
+            st = self._sites.get(site)
+            if st is None:
+                st = self._sites[site] = {"compiles": 0, "sigs": []}
+            st["compiles"] += 1
+            n = st["compiles"]
+            if sig not in st["sigs"]:
+                if len(st["sigs"]) >= _MAX_SIGS_KEPT:
+                    st["sigs"].pop(0)
+                st["sigs"].append(sig)
+            n_sigs = len(st["sigs"])
+        is_recompile = n > 1
+        if is_recompile:
+            recompile_counter.inc()
+        armed = steps_marked >= self.warmup_steps
+        if is_recompile and armed:
+            self.warnings_fired += 1
+            _LOG.warning(
+                "recompile #%d of %s for signature %s — jit cache miss "
+                "after warmup (%d distinct signatures seen; a growing "
+                "count means shapes/dtypes/static hypers are varying "
+                "per call and every step pays a fresh XLA compile)",
+                n, site, sig, n_sigs)
+            if event_log is not None:
+                event_log.emit("watchdog.recompile", kind="instant",
+                               site=site, signature=sig, compile_no=n,
+                               distinct_signatures=n_sigs)
+
+    def site_stats(self):
+        with self._lock:
+            return {site: {"compiles": st["compiles"],
+                           "distinct_signatures": len(st["sigs"])}
+                    for site, st in self._sites.items()}
