@@ -3,8 +3,9 @@
 Default metric mirrors the reference's headline benchmark
 (example/image-classification/benchmark_score.py; docs/.../faq/perf.md —
 V100 fp16 ResNet-50 batch 128: 2355.04 img/s, BASELINE.md). Select with
-argv[1] or BENCH env: resnet (default) | resnet_train | lstm_lm |
-bert_pretrain | bert_large_pretrain | optimizer_step | telemetry_overhead.
+argv[1] or BENCH env: resnet (default) | resnet_train | train_step |
+lstm_lm | bert_pretrain | bert_large_pretrain | optimizer_step |
+telemetry_overhead.
 
 Robustness contract (round-1 postmortem): any failure — backend init,
 compile, OOM — still emits a parseable JSON line with an "error" field and
@@ -104,28 +105,125 @@ def bench_resnet_train():
 
     from mxnet_tpu import amp
 
+    # "compiled" (default) = Trainer.compile_step, the whole step as ONE
+    # donated-buffer program; "learner" = the pre-existing parallel.Learner
+    # path (forward+backward program + fused optimizer program)
+    path = os.environ.get("BENCH_RESNET_TRAIN_PATH", "compiled")
     BATCH, WARMUP, ITERS = 128, 2, 8
     net = vision.resnet50_v1(classes=1000)
     net.initialize()
     amp.init("bfloat16")  # MXU ops run bf16, params/optimizer state fp32
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    learner = parallel.Learner(net, loss_fn,
-                               mx.optimizer.SGD(learning_rate=0.1,
-                                                momentum=0.9))
     x = mx.np.random.uniform(size=(BATCH, 3, 224, 224)).astype("bfloat16")
     y = mx.np.random.randint(0, 1000, size=(BATCH,)).astype("float32")
+    if path == "compiled":
+        trainer = gluon.Trainer(net.collect_params(),
+                                mx.optimizer.SGD(learning_rate=0.1,
+                                                 momentum=0.9))
+        step = trainer.compile_step(net, loss_fn)
+        if step.fallback_reason is not None:
+            raise RuntimeError("compile_step fell back: "
+                               + step.fallback_reason)
+    else:
+        learner = parallel.Learner(net, loss_fn,
+                                   mx.optimizer.SGD(learning_rate=0.1,
+                                                    momentum=0.9))
+        step = learner.step
     for _ in range(WARMUP):
-        _sync(learner.step(x, y)._data)
+        _sync(step(x, y)._data)
     t0 = time.perf_counter()
     for _ in range(ITERS):
-        loss = learner.step(x, y)
+        loss = step(x, y)
     _sync(loss._data)
     dt = time.perf_counter() - t0
     img_s = BATCH * ITERS / dt
     return {"metric": "resnet50_train_batch128",
             "value": round(img_s, 2), "unit": "img/s",
             "vs_baseline": round(img_s / BASELINE_RESNET_TRAIN, 3),
+            "path": path,  # workload variant: keeps rounds comparable
             "mfu": _mfu(img_s * RESNET50_TRAIN_FLOPS)}
+
+
+def bench_train_step():
+    """Whole-step compilation (Trainer.compile_step: ONE donated-buffer
+    program per step) against the eager record/backward/``Trainer.step``
+    loop, on an MLP+BN classifier. Reports compiled steps/s, the
+    compiled/eager ratio, dispatches/step, and compile counts (from
+    telemetry, measured outside the timed loops). BENCH_TRAIN_STEP_SMALL=1
+    shrinks the model/iterations for the not-slow suite."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd as ag, gluon, telemetry
+    from mxnet_tpu.gluon import nn
+
+    small = os.environ.get("BENCH_TRAIN_STEP_SMALL", "") == "1"
+    B, H, WARMUP, ITERS = (32, 64, 2, 10) if small else (128, 512, 3, 30)
+
+    def make_net():
+        mx.random.seed(7)
+        net = nn.Sequential()
+        net.add(nn.Dense(H, activation="relu"), nn.BatchNorm(),
+                nn.Dense(H, activation="relu"), nn.Dense(10))
+        net.initialize()
+        return net
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.standard_normal((B, H)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 10, (B,)).astype("float32"))
+    opt_args = ("sgd", {"learning_rate": 0.05, "momentum": 0.9})
+
+    net_e = make_net()
+    tr_e = gluon.Trainer(net_e.collect_params(), *opt_args)
+
+    def eager_step():
+        with ag.record():
+            loss = loss_fn(net_e(x), y).mean()
+        loss.backward()
+        tr_e.step(1)
+        return loss
+
+    for _ in range(WARMUP):
+        _sync(eager_step()._data)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = eager_step()
+    _sync(loss._data)
+    eager_sps = ITERS / (time.perf_counter() - t0)
+
+    net_c = make_net()
+    tr_c = gluon.Trainer(net_c.collect_params(), *opt_args)
+    step = tr_c.compile_step(net_c, loss_fn)
+    if step.fallback_reason is not None:
+        raise RuntimeError("compile_step fell back: " + step.fallback_reason)
+    for _ in range(WARMUP):
+        _sync(step(x, y)._data)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        loss = step(x, y)
+    _sync(loss._data)
+    compiled_sps = ITERS / (time.perf_counter() - t0)
+
+    # accounting pass AFTER the timed loops: telemetry on, a few steps,
+    # read dispatches/recompiles per step from the accountant
+    was_on = telemetry.is_enabled()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        for _ in range(3):
+            _sync(step(x, y)._data)
+        rows = telemetry.step_report()
+    finally:
+        telemetry.enable() if was_on else telemetry.disable()
+    disp = max(r["dispatches"] for r in rows) if rows else -1
+    recomp = sum(r["recompiles"] for r in rows) if rows else -1
+    return {"metric": "train_step_compiled_mlp",
+            "value": round(compiled_sps, 2), "unit": "steps/s",
+            "vs_baseline": round(compiled_sps / max(eager_sps, 1e-9), 3),
+            "eager_steps_per_sec": round(eager_sps, 2),
+            "dispatches_per_step": disp,
+            "recompiles_after_warmup": recomp,
+            "compiled_programs": step._traces,
+            "mfu": None}
 
 
 def bench_lstm_lm():
@@ -428,6 +526,7 @@ def main():
     try:
         fn = {"resnet": bench_resnet_infer,
               "resnet_train": bench_resnet_train,
+              "train_step": bench_train_step,
               "lstm_lm": bench_lstm_lm,
               "bert_pretrain": bench_bert_pretrain,
               "bert_large_pretrain": functools.partial(bench_bert_pretrain,

@@ -17,6 +17,7 @@ from .loss_scaler import LossScaler, DynamicLossScaler, StaticLossScaler
 from .lists import AMP_DTYPES, FP32_FUNCS, MXU_FUNCS
 
 __all__ = ["init", "is_enabled", "target_dtype", "scale_loss", "unscale",
+           "attach_loss_scaler",
            "convert_hybrid_block", "LossScaler", "DynamicLossScaler",
            "StaticLossScaler", "autocast", "MXU_FUNCS", "FP32_FUNCS",
            "AMP_DTYPES", "resolve_dtype"]
@@ -90,6 +91,18 @@ class autocast:
         _st().enabled, _st().dtype = self._prev
 
 
+def attach_loss_scaler(optimizer_or_trainer, scaler=None):
+    """Attach (or create) the loss scaler ``scale_loss`` and
+    ``Trainer.compile_step`` consult; returns it. Passing an explicit
+    ``scaler`` replaces any existing one."""
+    if scaler is None:
+        scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            scaler = DynamicLossScaler()
+    optimizer_or_trainer._amp_loss_scaler = scaler
+    return scaler
+
+
 def scale_loss(loss, optimizer_or_trainer):
     """Reference-parity loss scaling context (no-op for bf16)."""
     import contextlib
@@ -99,10 +112,7 @@ def scale_loss(loss, optimizer_or_trainer):
         if _st().dtype == "bfloat16":
             yield loss
         else:
-            scaler = getattr(optimizer_or_trainer, "_amp_loss_scaler", None)
-            if scaler is None:
-                scaler = DynamicLossScaler()
-                optimizer_or_trainer._amp_loss_scaler = scaler
+            scaler = attach_loss_scaler(optimizer_or_trainer)
             yield loss * scaler.loss_scale
 
     return ctx()

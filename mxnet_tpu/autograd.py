@@ -36,6 +36,7 @@ __all__ = [
     "mark_variables",
     "backward",
     "grad",
+    "program_vjp",
 ]
 
 
@@ -152,6 +153,27 @@ def _record_op(fn, inputs, datas):
         inputs=tuple(inputs),
     )
     return out_data, node
+
+
+def program_vjp(fn, primals, head_grad):
+    """Whole-program backward INSIDE a trace: ``(outs, input_cotangents)``.
+
+    ``fn(*primals)`` must return a tuple whose first element is the scalar
+    loss; ``head_grad`` seeds its cotangent (the compiled train step passes
+    the loss scale here, so scaled-loss backward needs no retrace) and every
+    extra output (aux write-backs — BN moving stats) gets the zero
+    cotangent, the same convention the eager tape walk applies to unused
+    outputs (``_zero_cotangent``). This is the in-trace counterpart of
+    ``backward()``: instead of walking per-op vjp closures on the host, the
+    transposed program becomes part of the caller's jit trace — the analog
+    of CachedOp::Backward's full-graph pass for the WHOLE step."""
+    import jax.numpy as jnp
+
+    outs, vjp_fn = jax.vjp(fn, *primals)
+    cots = (jnp.asarray(head_grad, outs[0].dtype),) + tuple(
+        _zero_cotangent(o.shape, o.dtype) for o in outs[1:])
+    in_cots = vjp_fn(cots)
+    return outs, in_cots
 
 
 def mark_variables(variables, gradients, grad_reqs="write"):

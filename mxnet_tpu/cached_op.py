@@ -113,20 +113,8 @@ class CachedOp:
             )
         tm = self._telemetry
         if tm.ON:
-            # attribute host time to compile vs steady-state call: a trace
-            # of this program reports record_compile synchronously inside
-            # invoke, so the compile-counter delta tells the two apart
-            import time as _time
-
-            c0 = tm.compile_count()
-            wall0 = _time.time()
-            t0 = _time.perf_counter()
-            outs = invoke(self._op, inputs, {})
-            dt = _time.perf_counter() - t0
-            name = ("cached_op.compile" if tm.compile_count() > c0
-                    else "cached_op.call")
-            tm.timer(name).record(dt)
-            tm._maybe_span(name, wall0, dt)  # trace timeline lane
+            with tm.program_timer("cached_op"):
+                outs = invoke(self._op, inputs, {})
         else:
             outs = invoke(self._op, inputs, {})
         if not isinstance(outs, tuple):
@@ -139,6 +127,11 @@ class CachedOp:
     def lower_hlo(self, *example_inputs):
         """Return the StableHLO text for given example inputs (debugging)."""
         datas = [x._data for x in example_inputs]
+        if self._uses_rng:
+            # the compiled program's leading argument is the per-call PRNG
+            # key (see __init__); synthesize one so lowering an RNG graph
+            # (dropout) matches the program's true arity
+            datas.insert(0, jax.random.PRNGKey(0))
         return self._jitted.lower(*datas).as_text()
 
 

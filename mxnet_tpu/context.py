@@ -232,34 +232,74 @@ def _subprocess_backend_probe(timeout_s: float) -> tuple[str | None, bool]:
     return None, timed_out
 
 
-def _probe_marker_path():
+def _probe_cache_path():
     import os
     import tempfile
 
     return os.path.join(tempfile.gettempdir(),
-                        f".mxtpu_backend_ok_{os.getuid()}")
+                        f".mxtpu_backend_probe_{os.getuid()}.json")
 
 
-def _probe_marker_fresh() -> bool:
-    """A recent successful accelerator init (any process) lets fresh
-    processes skip the subprocess probe. TTL-bounded: a runtime that died
-    inside the window can still hang us, so keep the window short."""
+def _probe_env_signature() -> str:
+    """Hash of everything that can change the probe's verdict — a cached
+    verdict only applies to an identical (interpreter, jax, platform-env)
+    configuration; change any of these and the next run re-probes."""
+    import hashlib
+    import os
+    import sys
+
+    import jax
+
+    parts = [sys.executable, getattr(jax, "__version__", "?")]
+    for k in ("JAX_PLATFORMS", "TPU_NAME", "TPU_LIBRARY_PATH",
+              "PJRT_DEVICE", "MXTPU_BACKEND_PROBE_TIMEOUT_S"):
+        parts.append(f"{k}={os.environ.get(k, '')}")
+    return hashlib.sha256("\0".join(parts).encode()).hexdigest()[:16]
+
+
+def _load_cached_probe(sig):
+    """The fresh on-disk verdict for this env signature, or None.
+
+    Both successes AND failures are cached; the failure verdict is the
+    valuable one — a second bench run against the same unreachable
+    accelerator pins to CPU immediately instead of re-paying the probe
+    timeout. TTL-bounded (``MXTPU_PROBE_CACHE_TTL_S``, default 600 s,
+    0 disables): a runtime that died inside the window can still hang a
+    trusted in-process init, so keep the window short."""
+    import json
     import os
     import time
 
     ttl = float(os.environ.get("MXTPU_PROBE_CACHE_TTL_S", "600"))
     if ttl <= 0:
-        return False
+        return None
     try:
-        return (time.time() - os.stat(_probe_marker_path()).st_mtime) < ttl
-    except OSError:
-        return False
+        with open(_probe_cache_path()) as fh:
+            entry = json.load(fh).get(sig)
+    except (OSError, ValueError):
+        return None
+    if entry and (time.time() - float(entry.get("ts", 0))) < ttl:
+        return entry
+    return None
 
 
-def _write_probe_marker():
+def _store_cached_probe(sig, backend, error=None):
+    import json
+    import os
+    import time
+
+    path = _probe_cache_path()
     try:
-        with open(_probe_marker_path(), "w") as fh:
-            fh.write("ok\n")
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            data = {}
+        data[sig] = {"backend": backend, "error": error, "ts": time.time()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(data, fh)
+        os.replace(tmp, path)
     except OSError:
         pass
 
@@ -311,6 +351,16 @@ def default_backend() -> str:
             pass
     live = bool(getattr(_xb, "_backends", None))
     if cpu_only or live:
+        # direct in-process call: backends already live or the platform
+        # list is pure CPU — an explicitly-set JAX_PLATFORMS=cpu therefore
+        # skips the subprocess probe entirely (the common bench/test case).
+        # An explicit ACCELERATOR platform list does NOT qualify for an
+        # unguarded in-process init: deployment site hooks export
+        # JAX_PLATFORMS=<accel> into every process, and when the runtime
+        # is dead that init blocks >10 min inside make_c_api_client. Those
+        # environments skip the probe through the disk cache below — one
+        # probed verdict per env signature per TTL, every later run is
+        # probe-free.
         try:
             b = jax.default_backend()
         except RuntimeError as e:
@@ -322,18 +372,48 @@ def default_backend() -> str:
         _probe_cache["backend"] = b
         return b
 
-    if os.environ.get("MXTPU_SKIP_BACKEND_PROBE", "") == "1" \
-            or _probe_marker_fresh():
-        # operator asserts the runtime is healthy (env var), or another
-        # process proved it recently (marker file): skip the child-process
-        # round trip — a full duplicate backend init (~20-40s of TPU first
-        # contact) — and init in-process directly
+    sig = _probe_env_signature()
+    if os.environ.get("MXTPU_SKIP_BACKEND_PROBE", "") == "1":
+        # operator asserts the runtime is healthy: skip the child-process
+        # round trip (~20-40s of TPU first contact) and init directly
         try:
             b = jax.default_backend()
         except RuntimeError:
             b = "cpu"
-        if _is_tpu_platform(b):
-            _write_probe_marker()  # refresh the health window
+        _store_cached_probe(sig, b)
+        _probe_cache["backend"] = b
+        return b
+    cached = _load_cached_probe(sig)
+    if cached is not None:
+        if cached.get("error"):
+            # a recent probe in this SAME environment already failed —
+            # pin to CPU right away instead of re-paying the timeout
+            _probe_cache["error"] = cached["error"]
+            warnings.warn(
+                "accelerator backend probe failed recently in this "
+                "environment; pinning to CPU from the cached verdict. "
+                f"Delete {_probe_cache_path()} or set "
+                "MXTPU_PROBE_CACHE_TTL_S=0 to re-probe.",
+                RuntimeWarning, stacklevel=2)
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+            _probe_cache["backend"] = "cpu"
+            return "cpu"
+        # a recent probe in this environment succeeded: trust it and init
+        # in-process without the duplicate child init. A cached CPU verdict
+        # still pins first — an unpinned init would dial the (absent)
+        # accelerator plugin the probe never vouched for.
+        if cached.get("backend") == "cpu":
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        try:
+            b = jax.default_backend()
+        except RuntimeError:
+            b = "cpu"
         _probe_cache["backend"] = b
         return b
     timeout_s = float(os.environ.get("MXTPU_BACKEND_PROBE_TIMEOUT_S", "300"))
@@ -342,6 +422,7 @@ def default_backend() -> str:
         # fast nonzero-exit failures can be transient tunnel hiccups —
         # retry once; a TIMEOUT is a deterministic hang, don't double it
         probed, timed_out = _subprocess_backend_probe(timeout_s)
+    failed = probed is None
     if probed is None or probed == "cpu":
         if probed is None:
             warnings.warn(
@@ -349,7 +430,12 @@ def default_backend() -> str:
                 + ("timed out" if timed_out else "failed twice")
                 + f" (budget {timeout_s:.0f}s); pinning this process to "
                 "CPU. Set MXTPU_BACKEND_PROBE_TIMEOUT_S or JAX_PLATFORMS "
-                "to override.", RuntimeWarning, stacklevel=2)
+                "to override. The verdict is cached on disk so the next "
+                "run in this environment skips the wait.",
+                RuntimeWarning, stacklevel=2)
+            _store_cached_probe(sig, "cpu",
+                                error=_probe_cache.get("error")
+                                or "backend probe failed")
         try:
             jax.config.update("jax_platforms", "cpu")
         except Exception:
@@ -364,8 +450,8 @@ def default_backend() -> str:
             "successful probe; falling back to CPU.",
             RuntimeWarning, stacklevel=2)
         b = "cpu"
-    if _is_tpu_platform(b):
-        _write_probe_marker()
+    if not failed:  # never overwrite the cached FAILURE verdict above
+        _store_cached_probe(sig, b)
     _probe_cache["backend"] = b
     return b
 

@@ -62,6 +62,7 @@ class Trainer:
         self._fused_fn = {}        # parameter-signature -> jitted multi-step
         self._fused_traces = 0     # trace-time count: observes recompiles
         self._fused_dispatches = 0 # compiled-program calls made by fusion
+        self._compiled_step = None # CompiledTrainStep from compile_step()
 
     # -- properties ---------------------------------------------------------
     @property
@@ -74,6 +75,29 @@ class Trainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
+
+    # -- whole-step compilation ---------------------------------------------
+    def compile_step(self, net, loss_fn, mesh=None, loss_scaler=None):
+        """Compile forward + loss + backward (+ mesh allreduce) + update into
+        ONE donated-buffer program; returns the CompiledTrainStep, also
+        exposed as ``self.step_fn``. Semantics of the compiled callable match
+        the eager loop ``loss_fn(net(x), y).mean(); backward(); step(1)``.
+        Unsupported configurations fall back to that eager loop with a
+        one-time warning (see CompiledTrainStep.fallback_reason)."""
+        from ..train_step import CompiledTrainStep
+
+        self._compiled_step = CompiledTrainStep(
+            self, net, loss_fn, mesh=mesh, loss_scaler=loss_scaler)
+        return self._compiled_step
+
+    @property
+    def step_fn(self):
+        """The functional train step built by ``compile_step``."""
+        if self._compiled_step is None:
+            raise MXNetError(
+                "no compiled step: call trainer.compile_step(net, loss_fn) "
+                "first")
+        return self._compiled_step
 
     # -- kvstore ------------------------------------------------------------
     def _init_kvstore(self):

@@ -63,6 +63,15 @@ class KVStoreBase:
         return 1
 
     @property
+    def supports_compiled_step(self):
+        """True when the whole train step may compile into ONE program while
+        this store is attached: single-worker stores only reduce locally (a
+        no-op or an in-program mesh collective), so no out-of-program
+        push/pull is required per step. Multi-worker stores move gradients
+        through host-side collectives and force the uncompiled path."""
+        return self.num_workers == 1
+
+    @property
     def type(self):
         return type(self).__name__.lower()
 

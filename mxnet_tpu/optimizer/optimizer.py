@@ -84,9 +84,9 @@ class Optimizer:
     def set_wd_mult(self, args_wd_mult):
         self.wd_mult = dict(args_wd_mult)
 
-    def _get_lr(self, index):
-        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler \
-            else self.lr
+    def _get_lr(self, index, num_update=None):
+        nu = self.num_update if num_update is None else num_update
+        lr = self.lr_scheduler(nu) if self.lr_scheduler else self.lr
         if index in self.param_dict:
             lr *= self.param_dict[index].lr_mult
         return lr * self.lr_mult.get(index, 1.0)
@@ -103,6 +103,22 @@ class Optimizer:
         self.num_update = max(self.num_update,
                               self._index_update_count[index])
         return self._index_update_count[index]
+
+    def _staged_counts(self, indices):
+        """Tentative per-index update counts + num_update WITHOUT mutating.
+
+        The compiled train step must compute t/lr for the step it is about
+        to run, but may later SKIP that step (DynamicLossScaler overflow) —
+        the schedule must then stay untouched, exactly as when the eager
+        loop skips ``trainer.step``. Returns ``(counts, num_update)``
+        matching what ``_update_count`` + ``_get_lr`` would have seen."""
+        counts = [self._index_update_count.get(i, 0) + 1 for i in indices]
+        return counts, max([self.num_update] + counts)
+
+    def _commit_counts(self, indices):
+        """Apply the counts previously staged by ``_staged_counts``."""
+        for i in indices:
+            self._update_count(i)
 
     # -- state --------------------------------------------------------------
     def create_state(self, index, weight) -> dict:

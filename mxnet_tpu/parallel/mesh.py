@@ -15,7 +15,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 
 __all__ = ["AxisNames", "make_mesh", "default_mesh", "replicated",
-           "shard_batch", "shard_params", "P"]
+           "shard_batch", "shard_params", "shard_map_compat", "P"]
 
 
 class AxisNames:
@@ -68,6 +68,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def shard_batch(mesh: Mesh, axis: str = AxisNames.DP) -> NamedSharding:
     """Shard dim 0 (batch) over ``axis``; everything else replicated."""
     return NamedSharding(mesh, P(axis))
+
+
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` across the jax API moves (experimental -> top level,
+    check_rep -> check_vma). Replication checking is disabled: the compiled
+    train step mixes per-shard values (``axis_index``-folded RNG keys) with
+    psum'ed results, which the static rep checker over-rejects on some
+    versions."""
+    if hasattr(jax, "shard_map"):  # jax >= 0.6
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
 
 
 def shard_params(mesh: Mesh, spec_fn=None):

@@ -36,7 +36,7 @@ from .monitor import Monitor
 
 __all__ = ["enable", "disable", "is_enabled", "configure", "reset",
            "counter", "gauge", "timer", "metrics", "event", "events",
-           "dump_events", "export_chrome_trace", "mark_step",
+           "dump_events", "export_chrome_trace", "mark_step", "program_timer",
            "step_report", "last_step", "watchdog_stats", "Monitor",
            "Counter", "Gauge", "Timer", "Registry", "format_signature"]
 
@@ -158,6 +158,30 @@ def step_report(reset=False):
 
 def last_step():
     return STEPS.last()
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def program_timer(site):
+    """Attribute one compiled-program call's host time to ``<site>.compile``
+    or ``<site>.call``: a trace of the program reports record_compile
+    synchronously inside the call, so the compile-counter delta tells the
+    two apart. Shared by CachedOp and the compiled train step; callers
+    guard on ``telemetry.ON`` (the manager itself is trace-cost only)."""
+    import time as _time
+
+    c0 = compile_count()
+    wall0 = _time.time()
+    t0 = _time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = _time.perf_counter() - t0
+        name = f"{site}.compile" if compile_count() > c0 else f"{site}.call"
+        REGISTRY.timer(name).record(dt)
+        _maybe_span(name, wall0, dt)  # trace timeline lane
 
 
 # -- compile observation (called from INSIDE traced bodies) -----------------

@@ -1,0 +1,393 @@
+"""CompiledTrainStep: the WHOLE training step as one donated-buffer program.
+
+TPU-native analog of the reference CachedOp's graph-level bulking (and of
+PyGraph's whole-iteration CUDA-graph capture): forward + loss + backward +
+gradient rescale + (under a mesh) the data-parallel all-reduce + the
+registered optimizer recurrence trace into ONE ``jax.jit`` program with the
+weight and optimizer-state buffers donated. Steady state is exactly one host
+dispatch per step; the loss scalar (and BN moving-stat write-backs) are the
+only things that come home.
+
+Reuses the existing pieces instead of duplicating them:
+
+- the forward is captured with ``_deferred_compute`` tracing and replayed by
+  ``CachedOp``'s executor (``build_executor``) — the same machinery
+  ``hybridize()`` uses;
+- the backward is ``autograd.program_vjp`` INSIDE the trace — the transposed
+  program is part of the step, not a host-side tape walk;
+- the update unrolls ``Optimizer._register_step``'s pure per-tensor
+  recurrence (the PR-1 declaration) per parameter;
+- the data-parallel path runs the body under ``shard_map`` and reduces
+  gradients with ``parallel.collectives.all_reduce``.
+
+Hyper-parameters (lr / wd / t / rescale / loss scale) ride as RUNTIME
+operands — an LR schedule or a ``DynamicLossScaler`` causes zero recompiles.
+With a loss scaler the program additionally returns an overflow flag
+computed in-program (finiteness of the scaled gradients); on overflow the
+update is a ``where``-select no-op and the host skips the schedule commit,
+matching the eager skip-on-overflow loop.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .base import MXNetError
+from . import telemetry as _telemetry
+
+__all__ = ["CompiledTrainStep"]
+
+
+class _Program:
+    """One compiled step program + the trace metadata needed to drive it."""
+
+    __slots__ = ("fn", "uses_rng", "aux_targets", "n_aux")
+
+    def __init__(self, fn, uses_rng, aux_targets):
+        self.fn = fn
+        self.uses_rng = uses_rng
+        self.aux_targets = aux_targets
+        self.n_aux = len(aux_targets)
+
+
+class CompiledTrainStep:
+    """Callable ``(x, y) -> loss`` running the whole step as one program.
+
+    Built via ``Trainer.compile_step(net, loss_fn)``. Semantics are those of
+    the eager loop ``loss_fn(net(x), y).mean(); backward(); trainer.step(1)``
+    — the loss is batch-normalized by the ``.mean()``, so the optimizer's
+    ``rescale_grad`` is applied as-is (no per-call batch division).
+
+    Falls back to the eager record/backward/``Trainer.step`` path (with a
+    one-time warning, reason in ``.fallback_reason``) when the step cannot
+    soundly compile: optimizer without a registered fusable recurrence
+    (e.g. SGLD's host RNG), ``multi_precision`` master weights,
+    ``update_on_kvstore``, a multi-worker kvstore (gradients reduce outside
+    the program), or non-float trainables.
+    """
+
+    def __init__(self, trainer, net, loss_fn, mesh=None, loss_scaler=None,
+                 name="train_step"):
+        self.trainer = trainer
+        self.net = net
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.loss_scaler = loss_scaler if loss_scaler is not None \
+            else getattr(trainer, "_amp_loss_scaler", None)
+        self.name = name
+        self.fallback_reason = None
+        self._warned = False
+        self._cache = {}       # input signature -> _Program
+        self._train_idx = None
+        self._frozen = None
+        self._state_keys = ()
+        self._traces = 0       # trace-time count (observes recompiles)
+        self._dispatches = 0   # compiled-program calls
+        self._check_supported()
+
+    # -- support matrix -----------------------------------------------------
+    def _check_supported(self):
+        tr = self.trainer
+        opt = tr._optimizer
+        if opt.fused_step is None:
+            self.fallback_reason = (
+                f"{type(opt).__name__} declares no fusable per-tensor step")
+            return
+        if opt.multi_precision:
+            self.fallback_reason = ("multi_precision uses the per-param "
+                                    "master-weight path")
+            return
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._kvstore is not None and tr._update_on_kvstore:
+            self.fallback_reason = "update_on_kvstore runs the optimizer " \
+                                   "on the store"
+            return
+        if tr._kvstore is not None and \
+                not tr._kvstore.supports_compiled_step:
+            self.fallback_reason = (
+                f"kvstore '{tr._kvstore.type}' reduces gradients outside "
+                "the program (num_workers > 1)")
+            return
+        if self.mesh is not None:
+            from .parallel.mesh import AxisNames
+
+            if AxisNames.DP not in self.mesh.axis_names:
+                raise MXNetError(
+                    f"compile_step mesh must carry a '{AxisNames.DP}' axis; "
+                    f"got {self.mesh.axis_names}")
+
+    # -- stepping -----------------------------------------------------------
+    def __call__(self, x, y):
+        if self.fallback_reason is not None:
+            return self._eager_step(x, y)
+        if self.mesh is not None:
+            from .parallel.mesh import AxisNames
+
+            n = self.mesh.shape[AxisNames.DP]
+            if x.shape[0] % n:
+                raise MXNetError(
+                    f"batch {x.shape[0]} not divisible by the mesh's "
+                    f"'{AxisNames.DP}' axis ({n} shards)")
+        sig = (x.shape, str(x.dtype), y.shape, str(y.dtype))
+        prog = self._cache.get(sig)
+        if prog is None:
+            prog = self._build(x, y)
+            if prog is None:  # trace discovered an unsupported layout
+                return self._eager_step(x, y)
+            self._cache[sig] = prog
+        return self._run(prog, x, y)
+
+    # -- tracing ------------------------------------------------------------
+    def _collect(self):
+        """Partition parameters into trainables (trainer order) and frozen
+        trace variables. EVERY initialized parameter of the net — including
+        BN running stats and other ``grad_req='null'`` state — becomes an
+        explicit graph input: an unmarked array would be captured as a baked
+        CONSTANT by the tracer, so step N+1 would silently read step 0's
+        stats (and a donated update could never reach them)."""
+        tr = self.trainer
+        train_idx = []
+        for i, p in enumerate(tr._params):
+            if p.grad_req == "null":
+                continue
+            if p._data is None:
+                raise MXNetError(
+                    f"parameter {p.name} not initialized — initialize the "
+                    "net (and run a settle forward for deferred shapes) "
+                    "before compile_step")
+            train_idx.append(i)
+        if not train_idx:
+            return None, None, "no trainable parameters"
+        seen = {id(tr._params[i]) for i in train_idx}
+        frozen = []
+        for pname, p in self.net.collect_params().items():
+            if id(p) not in seen and p._data is not None:
+                frozen.append((pname, p))
+        import jax.numpy as jnp
+
+        for i in train_idx:
+            if not jnp.issubdtype(tr._params[i].data().dtype, jnp.floating):
+                return None, None, \
+                    f"non-float trainable parameter {tr._params[i].name}"
+        return train_idx, frozen, None
+
+    def _build(self, x, y):
+        import jax
+        import jax.numpy as jnp
+
+        from . import _deferred_compute as dc
+        from . import autograd as ag
+        from .cached_op import build_executor
+
+        tr = self.trainer
+        opt = tr._optimizer
+        with ag.train_mode():
+            if any(p._data is None
+                   for p in self.net.collect_params().values()):
+                with ag.pause():  # settle deferred-shape init, no BN writes
+                    self.net(x)
+        train_idx, frozen, reason = self._collect()
+        if reason is not None:
+            self.fallback_reason = reason
+            return None
+        raw, state_keys, needs_t, _ = opt.fused_step
+        for i in train_idx:
+            if tr._states[i] is None:
+                tr._states[i] = opt.create_state_multi_precision(
+                    i, tr._params[i].data())
+            if any(k not in tr._states[i] for k in state_keys):
+                self.fallback_reason = (
+                    f"optimizer state for {tr._params[i].name} lacks "
+                    f"{state_keys} (restored from an older run?)")
+                return None
+        self._train_idx = train_idx
+        self._frozen = frozen
+        self._state_keys = state_keys
+
+        # --- capture the forward+loss graph (the hybridize machinery) ------
+        with ag.train_mode(), dc.context() as tctx:
+            dvars = [dc.set_variable(x, "data0"), dc.set_variable(y, "label0")]
+            wvars = [dc.set_variable(tr._params[i].data(), f"w{i}")
+                     for i in train_idx]
+            fvars = [dc.set_variable(p.data(), pname)
+                     for pname, p in frozen]
+            loss = self.loss_fn(self.net(x), y).mean()
+            if loss._dc_sym is None:
+                self.fallback_reason = \
+                    "loss is not connected to the traced forward"
+                return None
+            entries = [loss._dc_sym] + [e for _, e in tctx.aux_updates]
+            aux_targets = [t for t, _ in tctx.aux_updates]
+            fwd, uses_rng = build_executor(entries, dvars + wvars + fvars)
+
+        n_train = len(train_idx)
+        n_aux = len(aux_targets)
+        n_state = len(state_keys)
+        scaler_on = self.loss_scaler is not None
+        mesh = self.mesh
+        site = f"train_step:{self.name}"
+        attrs = (f"n_params={n_train} n_aux={n_aux} "
+                 f"opt={type(opt).__name__} scaler={scaler_on} "
+                 f"mesh={mesh is not None}")
+
+        def body(ws, ss, fs, xb, yb, key, lrs, wds, ts, rescale, loss_scale):
+            # executes at TRACE time only: the python loop unrolls into one
+            # program, and the observers below count recompiles, not calls
+            self._traces += 1
+            _telemetry.record_compile(site, (ws, xb), attrs=attrs)
+            if mesh is not None and uses_rng:
+                from .parallel import collectives as coll
+
+                # per-shard dropout masks: fold the shard index into the key
+                key = jax.random.fold_in(key, coll.axis_index("dp"))
+
+            def lfn(w_tuple):
+                args = ([key] if uses_rng else []) + [xb, yb] + \
+                    list(w_tuple) + list(fs)
+                return fwd(*args)
+
+            # backward INSIDE the trace, seeded with the loss scale so a
+            # DynamicLossScaler update never retraces (autograd.program_vjp)
+            outs, (grads,) = ag.program_vjp(lfn, (tuple(ws),), loss_scale)
+            loss_v, aux = outs[0], list(outs[1:])
+            if mesh is not None:
+                from .parallel import collectives as coll
+
+                # the data-parallel reduction, scheduled by XLA against the
+                # backward it interleaves with (the kvstore pushpull role)
+                grads = tuple(coll.all_reduce(g, "dp", op="mean")
+                              for g in grads)
+                loss_v = coll.all_reduce(loss_v, "dp", op="mean")
+                aux = [coll.all_reduce(a, "dp", op="mean") for a in aux]
+            # overflow = non-finite SCALED grads, the quantity the eager
+            # LossScaler.has_overflow inspects (before unscale)
+            finite = jnp.bool_(True)
+            for g in grads:
+                finite = jnp.logical_and(finite,
+                                         jnp.all(jnp.isfinite(g)))
+            overflow = jnp.logical_not(finite)
+            new_ws, new_ss = [], []
+            for k in range(n_train):
+                g = grads[k] * rescale
+                args = [ws[k], *ss[k], g, lrs[k], wds[k]]
+                if needs_t:
+                    args.append(ts[k])
+                out = raw(*args)
+                if n_state:
+                    nw, ns = out[0], tuple(out[1:])
+                else:
+                    nw, ns = out, ()
+                if scaler_on:
+                    # skip-on-overflow as a select: the step ran, the
+                    # weights didn't move (eager: trainer.step is skipped)
+                    nw = jnp.where(overflow, ws[k], nw)
+                    ns = tuple(jnp.where(overflow, s0, s1)
+                               for s0, s1 in zip(ss[k], ns))
+                new_ws.append(nw)
+                new_ss.append(ns)
+            return loss_v, tuple(aux), new_ws, new_ss, overflow
+
+        fn = body
+        if mesh is not None:
+            from .parallel.mesh import P, shard_map_compat
+
+            dp = P("dp")
+            fn = shard_map_compat(
+                body, mesh,
+                in_specs=(P(), P(), P(), dp, dp, P(), P(), P(), P(), P(),
+                          P()),
+                out_specs=P())
+        return _Program(jax.jit(fn, donate_argnums=(0, 1)), uses_rng,
+                        aux_targets)
+
+    # -- the compiled step --------------------------------------------------
+    def _run(self, prog, x, y):
+        import jax.numpy as jnp
+        import numpy as onp
+
+        tr = self.trainer
+        opt = tr._optimizer
+        idxs = self._train_idx
+        keys = self._state_keys
+        scaler = self.loss_scaler
+        ws = [tr._params[i].data()._data for i in idxs]
+        ss = [tuple(tr._states[i][k]._data for k in keys) for i in idxs]
+        fs = [p.data()._data for _, p in self._frozen]
+        if prog.uses_rng:
+            from . import random as _rnd
+
+            key = _rnd._next_key()
+        else:
+            key = jnp.zeros((2,), jnp.uint32)
+        # scalar schedule inputs are RUNTIME operands (the trainer rule):
+        # counts are STAGED, not committed — an overflow-skipped step must
+        # leave the schedule exactly where the eager skip would
+        counts, num_update = opt._staged_counts(idxs)
+        ts = onp.asarray(counts, onp.float32)
+        lrs = onp.asarray([opt._get_lr(i, num_update=num_update)
+                           for i in idxs], onp.float32)
+        wds = onp.asarray([opt._get_wd(i) for i in idxs], onp.float32)
+        scale = float(scaler.loss_scale) if scaler is not None else 1.0
+        rescale = onp.float32(tr._scale / scale)
+        loss_scale = onp.float32(scale)
+        self._dispatches += 1
+        if _telemetry.ON:
+            # ONE compiled-program call per step; this bypasses the
+            # invoke() chokepoint, so count the dispatch here
+            _telemetry.record_dispatch()
+            with _telemetry.program_timer("train_step"):
+                out = prog.fn(ws, ss, fs, x._data, y._data, key, lrs, wds,
+                              ts, rescale, loss_scale)
+        else:
+            out = prog.fn(ws, ss, fs, x._data, y._data, key, lrs, wds, ts,
+                          rescale, loss_scale)
+        loss_v, aux, new_ws, new_ss, overflow = out
+        for k, i in enumerate(idxs):
+            tr._params[i].data()._set_data(new_ws[k])
+            for sk, arr in zip(keys, new_ss[k]):
+                tr._states[i][sk]._set_data(arr)
+        # aux write-backs happen regardless of overflow: BN stats update
+        # during the forward, before the eager loop could inspect grads
+        for target, arr in zip(prog.aux_targets, aux):
+            target._set_data(arr)
+        if scaler is not None:
+            ovf = bool(overflow)  # the step's only host sync (1 byte)
+            scaler.update_scale(ovf)
+        else:
+            ovf = False
+        if not ovf:
+            opt._commit_counts(idxs)
+        if _telemetry.ON:
+            _telemetry.mark_step()
+        from .ndarray.ndarray import NDArray
+
+        return NDArray(loss_v)
+
+    # -- the uncompiled fallback -------------------------------------------
+    def _eager_step(self, x, y):
+        from . import autograd as ag
+
+        if not self._warned:
+            warnings.warn(
+                f"compile_step: falling back to the eager path — "
+                f"{self.fallback_reason}", RuntimeWarning, stacklevel=3)
+            self._warned = True
+        tr = self.trainer
+        scaler = self.loss_scaler
+        with ag.record():
+            loss = self.loss_fn(self.net(x), y).mean()
+            head = loss if scaler is None else loss * float(scaler.loss_scale)
+        head.backward()
+        if scaler is not None:
+            if scaler.has_overflow(tr._params):
+                scaler.update_scale(True)
+                if _telemetry.ON:
+                    _telemetry.mark_step()
+                return loss
+            for p in tr._params:
+                if p.grad_req != "null" and p._data is not None:
+                    g = p.grad()
+                    g._set_data(g._data / scaler.loss_scale)
+            scaler.update_scale(False)
+        tr.step(1)  # the loss carries the batch mean already
+        return loss

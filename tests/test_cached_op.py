@@ -147,6 +147,24 @@ def test_lower_hlo():
     assert "stablehlo" in hlo or "module" in hlo
 
 
+def test_lower_hlo_rng_graph():
+    """A graph that draws randomness compiles with a leading PRNG-key
+    argument; lower_hlo must synthesize that key, not call the jitted
+    program at data-only arity (ISSUE 3 satellite: previously raised a
+    TypeError/arity error for any dropout-bearing graph)."""
+    import mxnet_tpu as mx
+
+    x = np.ones((4, 4))
+
+    def fn(a):
+        return a + mx.np.random.uniform(size=a.shape)
+
+    _, _, cop = trace(fn, [x], [])
+    assert cop._uses_rng
+    hlo = cop.lower_hlo(x)
+    assert "stablehlo" in hlo or "module" in hlo
+
+
 def test_np_random_fresh_under_hybridize():
     """mx.np.random.* inside a hybridized block must redraw per call —
     the sampler routes through a registry rng op whose PRNG key is a
