@@ -5,7 +5,7 @@ Default metric mirrors the reference's headline benchmark
 V100 fp16 ResNet-50 batch 128: 2355.04 img/s, BASELINE.md). Select with
 argv[1] or BENCH env: resnet (default) | resnet_train | train_step |
 lstm_lm | bert_pretrain | bert_large_pretrain | optimizer_step |
-telemetry_overhead.
+telemetry_overhead | serve.
 
 Robustness contract (round-1 postmortem): any failure — backend init,
 compile, OOM — still emits a parseable JSON line with an "error" field and
@@ -475,6 +475,110 @@ def bench_telemetry_overhead():
             "mfu": None}
 
 
+def bench_serve():
+    """Inference fast path (serve.Predictor): 64 concurrent single-item
+    clients through the shape-bucketed dynamic batcher vs the same thread
+    harness doing naive per-request eager forwards on a non-hybridized
+    copy of the net. Reports req/s both ways, the serve/eager ratio
+    (acceptance bar: >= 3x), batch/dispatch accounting, padding waste,
+    p50/p99 latency, and compile counts — steady-state compiles after
+    warmup() must be 0. BENCH_SERVE_SMALL=1 shrinks clients/model for
+    the not-slow suite."""
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.gluon import nn
+
+    small = os.environ.get("BENCH_SERVE_SMALL", "") == "1"
+    CLIENTS, REQS, FEAT, HID = (16, 4, 32, 64) if small else (64, 8, 128, 256)
+
+    def make_net(hybrid):
+        mx.random.seed(11)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(HID, activation="relu"),
+                nn.Dense(HID, activation="relu"), nn.Dense(10))
+        net.initialize()
+        if hybrid:
+            net.hybridize()
+        return net
+
+    rs = onp.random.RandomState(3)
+    items = rs.standard_normal((CLIENTS * REQS, FEAT)).astype("float32")
+
+    def drive(worker):
+        # identical harness both ways: CLIENTS threads, REQS requests
+        # each, all released together; throughput over the joined wall
+        barrier = threading.Barrier(CLIENTS + 1)
+        errs = []
+
+        def client(cid):
+            try:
+                barrier.wait()
+                for r in range(REQS):
+                    worker(items[cid * REQS + r])
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(CLIENTS)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        return CLIENTS * REQS / dt
+
+    was_on = telemetry.is_enabled()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        # baseline: per-request eager forward, one item per call
+        net_e = make_net(hybrid=False)
+
+        def eager_worker(item):
+            _sync(net_e(mx.nd.array(item[None, :]))._data)
+
+        for k in range(3):  # warm the per-op programs
+            eager_worker(items[k])
+        eager_rps = drive(eager_worker)
+
+        # fast path: warmed Predictor, futures-based dynamic batching
+        pred = make_net(hybrid=True).predictor(
+            example=mx.nd.array(items[:CLIENTS]), max_batch=CLIENTS)
+        pred.warmup()
+        compiles_warmup = int(telemetry.metrics()["jit.compiles"])
+        yref = net_e(mx.nd.array(items[:1])).asnumpy()
+        ygot = pred.predict(mx.nd.array(items[:1])).asnumpy()
+        onp.testing.assert_allclose(ygot, yref, rtol=2e-4, atol=2e-4)
+
+        c0 = telemetry.metrics()["jit.compiles"]
+        serve_rps = drive(lambda item: pred.submit(item).result(120))
+        compiles_steady = int(telemetry.metrics()["jit.compiles"] - c0)
+        st = pred.stats()
+        pred.close()
+    finally:
+        telemetry.enable() if was_on else telemetry.disable()
+
+    return {"metric": "serve_dynamic_batch_64clients",
+            "value": round(serve_rps, 1), "unit": "req/s",
+            "vs_baseline": round(serve_rps / max(eager_rps, 1e-9), 3),
+            "eager_req_per_sec": round(eager_rps, 1),
+            "clients": CLIENTS, "requests": CLIENTS * REQS,
+            "dispatches": st["batches"],
+            "mean_occupancy": st["mean_occupancy"],
+            "padding_waste": st["padding_waste"],
+            "latency_ms_p50": st["latency_ms_p50"],
+            "latency_ms_p99": st["latency_ms_p99"],
+            "compiles_warmup": compiles_warmup,
+            "compiles_steady": compiles_steady,
+            "mfu": None}
+
+
 def _accel_expected():
     """True when this machine is configured for an accelerator, so a CPU
     result must be reported as a failure rather than published silently:
@@ -532,7 +636,8 @@ def main():
               "bert_large_pretrain": functools.partial(bench_bert_pretrain,
                                                        "large"),
               "optimizer_step": bench_optimizer_step,
-              "telemetry_overhead": bench_telemetry_overhead}[which]
+              "telemetry_overhead": bench_telemetry_overhead,
+              "serve": bench_serve}[which]
         # resolve the backend up front through the hardened probe: a hung
         # or dead TPU runtime must not kill the bench (round-1 failure:
         # raw RuntimeError) — and must not silently publish a CPU number
@@ -540,12 +645,17 @@ def main():
         # result). The bench can afford one generous init: default the
         # probe budget to 600 s here unless the operator set one.
         os.environ.setdefault("MXTPU_BACKEND_PROBE_TIMEOUT_S", "600")
-        from mxnet_tpu.context import default_backend, \
-            last_backend_probe_error
+        from mxnet_tpu.context import backend_probe_was_cached, \
+            default_backend, last_backend_probe_error
 
         backend = default_backend()
         result["backend"] = backend
         result["device"] = _device_info()[0]
+        # fail-fast accounting: True when the verdict came from the disk
+        # cache (no fresh subprocess probe was paid this run). Failure
+        # verdicts persist MXTPU_PROBE_FAIL_TTL_S (default 1 day), so a
+        # dead accelerator costs the 600 s budget once, not per bench.
+        result["probe_verdict_cached"] = backend_probe_was_cached()
         if backend == "cpu" and _accel_expected() \
                 and os.environ.get("BENCH_ALLOW_CPU", "") != "1":
             # TPU expected but unreachable: this is a failure to diagnose.

@@ -74,6 +74,7 @@ from . import runtime
 from . import util
 from . import parallel
 from . import amp
+from . import serve
 
 kv = kvstore
 

@@ -124,15 +124,36 @@ class CachedOp:
             target._set_data(new._data)
         return main[0] if self._n_main == 1 else main
 
+    def lower(self, *example_inputs):
+        """AOT-lower the program at the example signature (jax Lowered).
+
+        The compiled program's leading argument for RNG graphs is the
+        per-call PRNG key (see __init__); one is synthesized so lowering
+        matches the program's true arity. Lowering traces the executor, so
+        the recompile watchdog sees it like any jit cache miss.
+        """
+        datas = [getattr(x, "_data", x) for x in example_inputs]
+        if self._uses_rng:
+            datas.insert(0, jax.random.PRNGKey(0))
+        return self._jitted.lower(*datas)
+
     def lower_hlo(self, *example_inputs):
         """Return the StableHLO text for given example inputs (debugging)."""
-        datas = [x._data for x in example_inputs]
-        if self._uses_rng:
-            # the compiled program's leading argument is the per-call PRNG
-            # key (see __init__); synthesize one so lowering an RNG graph
-            # (dropout) matches the program's true arity
-            datas.insert(0, jax.random.PRNGKey(0))
-        return self._jitted.lower(*datas).as_text()
+        return self.lower(*example_inputs).as_text()
+
+    def aot_compile(self, *example_inputs):
+        """Ahead-of-time compile at the example signature; returns the
+        executable (jax Compiled).
+
+        The serve fast path (``serve.Predictor``) compiles one program per
+        shape bucket this way and calls the executables with raw device
+        arrays, bypassing the imperative dispatch/tape layers entirely.
+        The executable rejects any other input signature — pad to the
+        bucket before calling. With the persistent compilation cache on
+        (``context.enable_compilation_cache``), the XLA compile inside is
+        a disk hit on every process after the first.
+        """
+        return self.lower(*example_inputs).compile()
 
 
 def trace(fn, inputs, params=(), transform=None):

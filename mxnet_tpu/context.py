@@ -31,6 +31,9 @@ __all__ = [
     "num_tpus",
     "tpu_memory_info",
     "gpu_memory_info",
+    "compilation_cache_dir",
+    "enable_compilation_cache",
+    "disable_compilation_cache",
 ]
 
 _DEVTYPES = ("cpu", "tpu", "cpu_pinned", "cpu_shared", "gpu")
@@ -149,7 +152,14 @@ def device(dev: str | Context | None = None, device_id: int = 0) -> Context:
     raise MXNetError(f"cannot interpret {dev!r} as a device")
 
 
-_probe_cache = {"backend": None, "error": None}
+_probe_cache = {"backend": None, "error": None, "from_cache": False}
+
+
+def backend_probe_was_cached() -> bool:
+    """True when this process's backend verdict came from the on-disk
+    probe cache (no subprocess probe was paid). The bench reports it so
+    a fast-failed run is distinguishable from a freshly probed one."""
+    return bool(_probe_cache.get("from_cache"))
 
 
 def last_backend_probe_error() -> str | None:
@@ -260,25 +270,36 @@ def _probe_env_signature() -> str:
 def _load_cached_probe(sig):
     """The fresh on-disk verdict for this env signature, or None.
 
-    Both successes AND failures are cached; the failure verdict is the
-    valuable one — a second bench run against the same unreachable
-    accelerator pins to CPU immediately instead of re-paying the probe
-    timeout. TTL-bounded (``MXTPU_PROBE_CACHE_TTL_S``, default 600 s,
-    0 disables): a runtime that died inside the window can still hang a
-    trusted in-process init, so keep the window short."""
+    Both successes AND failures are cached, with ASYMMETRIC TTLs:
+
+    - success (``MXTPU_PROBE_CACHE_TTL_S``, default 600 s): a trusted
+      verdict leads straight to an in-process accelerator init, and a
+      runtime that died inside the window can still hang it — keep the
+      window short;
+    - failure (``MXTPU_PROBE_FAIL_TTL_S``, default 86400 s): the verdict
+      only pins the process to CPU, which is always safe — and it is the
+      valuable one: before this split, every bench run against the same
+      dead tunnel re-paid the full probe timeout because the 600 s window
+      had always lapsed by the next run (BENCH_r05 re-probed ~10 min).
+      A day-long failure window means one paid probe per environment per
+      day; delete the cache file or set the TTL to 0 to re-probe sooner.
+
+    Setting either TTL to 0 disables that class of cached verdict."""
     import json
     import os
     import time
 
     ttl = float(os.environ.get("MXTPU_PROBE_CACHE_TTL_S", "600"))
-    if ttl <= 0:
-        return None
+    fail_ttl = float(os.environ.get("MXTPU_PROBE_FAIL_TTL_S", "86400"))
     try:
         with open(_probe_cache_path()) as fh:
             entry = json.load(fh).get(sig)
     except (OSError, ValueError):
         return None
-    if entry and (time.time() - float(entry.get("ts", 0))) < ttl:
+    if not entry:
+        return None
+    limit = fail_ttl if entry.get("error") else ttl
+    if limit > 0 and (time.time() - float(entry.get("ts", 0))) < limit:
         return entry
     return None
 
@@ -385,6 +406,7 @@ def default_backend() -> str:
         return b
     cached = _load_cached_probe(sig)
     if cached is not None:
+        _probe_cache["from_cache"] = True
         if cached.get("error"):
             # a recent probe in this SAME environment already failed —
             # pin to CPU right away instead of re-paying the timeout
@@ -571,3 +593,99 @@ def num_tpus() -> int:
         return len(jax.local_devices(backend="tpu"))
     except RuntimeError:
         return 0
+
+
+# -- persistent compilation cache -------------------------------------------
+_compile_cache_state = {"dir": None, "enabled": False}
+
+
+def compilation_cache_dir() -> str | None:
+    """Resolved on-disk XLA compilation-cache directory for THIS
+    environment, or None when disabled.
+
+    Layout: ``<root>/<env signature>`` where root is
+    ``MXTPU_COMPILE_CACHE_DIR`` (default ``$TMPDIR/mxtpu_xla_cache_<uid>``)
+    and the leaf is the backend-probe environment signature
+    (:func:`_probe_env_signature`) — the same key that scopes probe
+    verdicts. Compiled XLA programs are only valid for an identical
+    (interpreter, jax, platform-env) configuration; keying the directory
+    by that signature means a cache populated under one configuration is
+    never replayed into another, and switching configurations simply
+    selects a sibling directory instead of invalidating anything.
+    Set ``MXTPU_COMPILE_CACHE_DIR=off`` to disable.
+    """
+    import os
+    import tempfile
+
+    root = os.environ.get("MXTPU_COMPILE_CACHE_DIR", "")
+    if root.lower() in ("0", "off", "none", "disabled"):
+        return None
+    if not root:
+        root = os.path.join(tempfile.gettempdir(),
+                            f"mxtpu_xla_cache_{os.getuid()}")
+    return os.path.join(root, _probe_env_signature())
+
+
+def enable_compilation_cache(path=None):
+    """Point jax's persistent compilation cache at ``path`` (default:
+    :func:`compilation_cache_dir`) so compiled XLA programs survive the
+    process — a fresh serving process re-traces its programs but restores
+    the expensive XLA compiles from disk (``serve.Predictor.warmup``
+    rides this to reach steady-state latency before the first request).
+
+    Thresholds are dropped to zero (min compile time / entry size) so
+    every program is cached, including the small per-bucket serving
+    programs the defaults would skip. Idempotent; returns the directory
+    in use, or None when disabled or when jax refuses the config (never
+    raises — serving works without persistence, just recompiles).
+    """
+    import os
+    import warnings
+
+    if path is None:
+        path = compilation_cache_dir()
+    if not path:
+        return None
+    if _compile_cache_state["enabled"] and \
+            _compile_cache_state["dir"] == path:
+        return path
+    import jax
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_enable_compilation_cache", True)
+        # jax latches the cache decision at the FIRST compile of the
+        # process: a compile before the dir was configured pins "no
+        # cache" for good unless the latch is reset. Framework import /
+        # model init always compiles something, so reset unconditionally.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # noqa: BLE001 — persistence is best-effort
+        warnings.warn(
+            f"could not enable the persistent compilation cache at "
+            f"{path}: {e!r}; compiles will not survive this process",
+            RuntimeWarning, stacklevel=2)
+        return None
+    _compile_cache_state.update(dir=path, enabled=True)
+    return path
+
+
+def disable_compilation_cache():
+    """Turn persistence back off (idempotent). The test suite calls this
+    after serve tests so later compile-heavy tests don't pay a disk write
+    per XLA compile."""
+    if not _compile_cache_state["enabled"]:
+        return
+    try:
+        import jax
+        from jax._src import compilation_cache as _cc
+
+        jax.config.update("jax_enable_compilation_cache", False)
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — best-effort teardown
+        pass
+    _compile_cache_state.update(dir=None, enabled=False)

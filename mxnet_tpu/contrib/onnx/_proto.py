@@ -55,6 +55,22 @@ def w_msg(field: int, payload: bytes) -> bytes:
     return w_bytes(field, payload)
 
 
+def w_bytes_header(field: int, nbytes: int) -> bytes:
+    """Header (tag + length) of a length-delimited field whose payload the
+    caller will emit separately. Lets multi-hundred-MB tensor payloads flow
+    to the output as their own chunks instead of being copied into every
+    enclosing message (TensorProto -> GraphProto -> ModelProto each concat
+    the full buffer otherwise — the dominant export cost for big models)."""
+    return _tag(field, 2) + _varint(nbytes)
+
+
+def w_msg_parts(field: int, parts: list) -> list:
+    """Chunked variant of :func:`w_msg`: wraps a list of bytes-like chunks
+    in a field header without joining them. ``len()`` of each chunk must be
+    its byte length (cast memoryviews to 'B' first)."""
+    return [w_bytes_header(field, sum(len(p) for p in parts)), *parts]
+
+
 # ---------------------------------------------------------------------------
 # primitive readers
 # ---------------------------------------------------------------------------
@@ -75,10 +91,23 @@ def _read_varint(buf: bytes, pos: int):
     return result, pos
 
 
-def iter_fields(buf: bytes):
-    """Yield (field_number, wire_type, value) over a message payload."""
+_BIG_FIELD = 1 << 20
+
+
+def iter_fields(buf):
+    """Yield (field_number, wire_type, value) over a message payload.
+
+    ``buf`` may be bytes or a memoryview. Length-delimited values under
+    1 MB come back as bytes (callers .decode() them); larger ones — in
+    practice only tensor raw_data and the messages enclosing it — come
+    back as zero-copy memoryviews, so parsing a multi-hundred-MB model
+    never duplicates the weight bytes at each nesting level
+    (ModelProto -> GraphProto -> TensorProto -> raw_data).
+    numpy's frombuffer accepts the view directly."""
     pos = 0
     n = len(buf)
+    is_view = isinstance(buf, memoryview)
+    big_src = buf if is_view else None
     while pos < n:
         key, pos = _read_varint(buf, pos)
         field, wire = key >> 3, key & 7
@@ -86,8 +115,14 @@ def iter_fields(buf: bytes):
             value, pos = _read_varint(buf, pos)
         elif wire == 2:
             length, pos = _read_varint(buf, pos)
-            value = buf[pos:pos + length]
-            pos += length
+            end = pos + length
+            if length >= _BIG_FIELD:
+                if big_src is None:
+                    big_src = memoryview(buf)
+                value = big_src[pos:end]
+            else:
+                value = bytes(buf[pos:end]) if is_view else buf[pos:end]
+            pos = end
         elif wire == 5:
             value = struct.unpack("<f", buf[pos:pos + 4])[0]
             pos += 4

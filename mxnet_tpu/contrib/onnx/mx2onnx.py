@@ -13,13 +13,16 @@ from . import _proto as P
 OPSET = 13
 
 
-def _tensor_proto(name, arr) -> bytes:
+def _tensor_proto_parts(name, arr) -> list:
+    """TensorProto as [small header bytes, raw-data memoryview] — the
+    weight payload is never copied; it rides as a zero-copy chunk all the
+    way to the file write (see _proto.w_bytes_header)."""
     arr = onp.ascontiguousarray(arr)
-    payload = b"".join(P.w_varint(1, d) for d in arr.shape)
-    payload += P.w_varint(2, P.np_to_onnx_dtype(arr.dtype))
-    payload += P.w_string(8, name)
-    payload += P.w_bytes(9, arr.tobytes())
-    return payload
+    head = b"".join(P.w_varint(1, d) for d in arr.shape)
+    head += P.w_varint(2, P.np_to_onnx_dtype(arr.dtype))
+    head += P.w_string(8, name)
+    return [head + P.w_bytes_header(9, arr.nbytes),
+            memoryview(arr).cast("B")]
 
 
 def _value_info(name, shape, dtype="float32") -> bytes:
@@ -82,7 +85,10 @@ class _Exporter:
         return f"{hint}_{self.counter}"
 
     def add_initializer(self, name, arr):
-        self.initializers.append(P.w_msg(5, _tensor_proto(name, arr)))
+        # a CHUNK LIST (not joined bytes): big weight payloads stay
+        # zero-copy until writelines
+        self.initializers.append(
+            P.w_msg_parts(5, _tensor_proto_parts(name, arr)))
 
     def shape_of(self, name):
         shp = self.shapes.get(name)
@@ -747,17 +753,23 @@ def export_symbol(sym: Symbol, params: dict, input_shapes: dict,
         else:
             graph_outputs.append(P.w_string(1, nm))
 
-    graph = b"".join(exp.nodes)
-    graph += P.w_string(2, "mxnet_tpu_graph")
-    graph += b"".join(exp.initializers)
-    graph += b"".join(P.w_msg(11, gi) for gi in graph_inputs)
-    graph += b"".join(P.w_msg(12, go) for go in graph_outputs)
+    # chunked assembly: weight payloads (memoryviews inside each
+    # initializer chunk list) are never concatenated — writelines hands
+    # them to the OS one by one, so a 500 MB model costs one disk write
+    # instead of ~8 full in-memory copies
+    graph_parts = [b"".join(exp.nodes), P.w_string(2, "mxnet_tpu_graph")]
+    for ini in exp.initializers:
+        graph_parts.extend(ini)
+    graph_parts.extend(P.w_msg(11, gi) for gi in graph_inputs)
+    graph_parts.extend(P.w_msg(12, go) for go in graph_outputs)
 
-    model = P.w_varint(1, 8)  # ir_version 8
-    model += P.w_string(2, producer)
-    model += P.w_msg(7, graph)
-    model += P.w_msg(8, P.w_varint(2, OPSET))  # default-domain opset
+    head = P.w_varint(1, 8)  # ir_version 8
+    head += P.w_string(2, producer)
+    head += P.w_bytes_header(7, sum(len(p) for p in graph_parts))
+    tail = P.w_msg(8, P.w_varint(2, OPSET))  # default-domain opset
 
-    with open(onnx_file_path, "wb") as f:
-        f.write(model)
+    # buffering=0: BufferedWriter would copy every chunk through its own
+    # buffer; raw FileIO hands each memoryview straight to one os.write
+    with open(onnx_file_path, "wb", buffering=0) as f:
+        f.writelines([head, *graph_parts, tail])
     return onnx_file_path

@@ -287,6 +287,32 @@ class HybridBlock(Block):
                              transform=transform)
         return cop, tree, [arr for _, arr in params]
 
+    # -- serving (serve.Predictor construction) ------------------------------
+    def _serving_graph(self, inputs):
+        """Trace this block in INFERENCE mode into (CachedOp, out_tree,
+        param_arrays) — the ``serve.Predictor`` construction hook.
+
+        Inference mode matters twice: the train-flag is part of the trace
+        (dropout folds away, BN reads running stats) and no aux updates
+        are registered, so the compiled program is a pure function safe
+        to replay concurrently from the serving dispatcher.
+        """
+        from .. import autograd
+
+        inputs = tuple(inputs)
+        with autograd.pause():
+            return self._build_cache(list(range(len(inputs))), inputs, {})
+
+    def predictor(self, example=None, **kwargs):
+        """A ``serve.Predictor`` wrapping this block (shape-bucketed,
+        dynamically batched, AOT-compiled inference — see
+        docs/DESIGN.md "Serving"). Keyword args pass through:
+        ``max_batch``, ``buckets``, ``max_wait_us``, ``cache_dir``,
+        ``manifest``."""
+        from ..serve import Predictor
+
+        return Predictor(self, example, **kwargs)
+
     # -- export (reference: block.py:1514) ----------------------------------
     def export(self, path, epoch=0, remove_amp_cast=True):
         """Serialize symbol JSON + params for deployment."""

@@ -1,0 +1,24 @@
+"""mxnet_tpu.serve — TPU-native inference subsystem (ISSUE 4).
+
+``Predictor`` wraps a hybridized Block behind (a) a shape-bucket ladder
+bounding the compiled-program set, (b) a futures-based dynamic batcher
+coalescing concurrent requests into padded device batches, and (c) jax's
+persistent compilation cache + a warmup manifest so a fresh process
+serves at steady-state latency from the first request.
+
+Quick start::
+
+    net.hybridize()
+    pred = net.predictor(example=x, max_batch=64)   # or serve.Predictor(net, x)
+    pred.warmup("model.warmup.json")                # compile every bucket
+    y = pred.predict(batch)                         # sync, any batch size
+    fut = pred.submit(single_item)                  # dynamic batching
+    fut.result()
+
+See docs/DESIGN.md "Serving".
+"""
+from .bucketing import bucket_ladder, pick_bucket, split_sizes
+from .predictor import Predictor, load_manifest
+
+__all__ = ["Predictor", "load_manifest", "bucket_ladder", "pick_bucket",
+           "split_sizes"]

@@ -29,16 +29,17 @@ from __future__ import annotations
 import os
 
 from .events import EventLog
-from .registry import Counter, Gauge, Registry, Timer
+from .registry import Counter, Gauge, Histogram, Registry, Timer
 from .step import StepTracker
 from .watchdog import Watchdog, format_signature
 from .monitor import Monitor
 
 __all__ = ["enable", "disable", "is_enabled", "configure", "reset",
-           "counter", "gauge", "timer", "metrics", "event", "events",
-           "dump_events", "export_chrome_trace", "mark_step", "program_timer",
-           "step_report", "last_step", "watchdog_stats", "Monitor",
-           "Counter", "Gauge", "Timer", "Registry", "format_signature"]
+           "counter", "gauge", "timer", "histogram", "metrics", "event",
+           "events", "dump_events", "export_chrome_trace", "mark_step",
+           "program_timer", "step_report", "last_step", "watchdog_stats",
+           "Monitor", "Counter", "Gauge", "Timer", "Histogram", "Registry",
+           "format_signature"]
 
 # THE gate. Instrumentation sites read this module attribute directly
 # (``if _telemetry.ON:``) — rebinding a module-level bool is the cheapest
@@ -106,6 +107,10 @@ def gauge(name) -> Gauge:
 
 def timer(name) -> Timer:
     return REGISTRY.timer(name)
+
+
+def histogram(name) -> Histogram:
+    return REGISTRY.histogram(name)
 
 
 def metrics() -> dict:

@@ -14,7 +14,7 @@ import contextlib
 import threading
 import time
 
-__all__ = ["Counter", "Gauge", "Timer", "Registry"]
+__all__ = ["Counter", "Gauge", "Timer", "Histogram", "Registry"]
 
 
 class Counter:
@@ -123,6 +123,91 @@ class Timer:
         return f"Timer({self.name}: {self._total:.6f}s/{self._count})"
 
 
+class Histogram:
+    """Percentile-capable sample metric (serve p50/p99 latency).
+
+    ``Timer`` only exposes totals/means, which hides tail latency — the
+    number a serving SLO is written against. A Histogram keeps a bounded
+    ring of the most recent ``capacity`` samples (old samples are
+    overwritten, so the percentiles always describe *recent* traffic)
+    plus exact running count/sum. Percentiles use the nearest-rank method
+    over a sorted copy of the ring — an O(n log n) read, paid only by the
+    reader, never by the recording hot path."""
+
+    __slots__ = ("name", "_buf", "_next", "_count", "_sum", "_lock", "_cap")
+
+    def __init__(self, name, capacity=8192):
+        self.name = name
+        self._cap = int(capacity)
+        self._buf = []
+        self._next = 0
+        self._count = 0
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, value):
+        v = float(value)
+        with self._lock:
+            if len(self._buf) < self._cap:
+                self._buf.append(v)
+            else:
+                self._buf[self._next] = v
+                self._next = (self._next + 1) % self._cap
+            self._count += 1
+            self._sum += v
+
+    def percentile(self, p):
+        """Nearest-rank percentile of the retained window; None when empty."""
+        return self.percentiles(p)[0]
+
+    def percentiles(self, *ps):
+        """Several percentiles from ONE sorted copy of the window."""
+        with self._lock:
+            data = sorted(self._buf)
+        if not data:
+            return [None] * len(ps)
+        n = len(data)
+        out = []
+        for p in ps:
+            if not 0 <= p <= 100:
+                from ..base import MXNetError
+
+                raise MXNetError(f"percentile {p} outside [0, 100]")
+            # nearest-rank: smallest value with at least p% of samples <= it
+            rank = max(int(-(-(p / 100.0 * n) // 1)), 1)  # ceil, min rank 1
+            out.append(data[rank - 1])
+        return out
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    @property
+    def mean(self):
+        return self._sum / self._count if self._count else 0.0
+
+    @property
+    def value(self):
+        """Snapshot dict: count/sum/mean plus p50/p90/p99 of the window."""
+        p50, p90, p99 = self.percentiles(50, 90, 99)
+        return {"count": self._count, "sum": self._sum, "mean": self.mean,
+                "p50": p50, "p90": p90, "p99": p99}
+
+    def reset(self):
+        with self._lock:
+            self._buf = []
+            self._next = 0
+            self._count = 0
+            self._sum = 0.0
+
+    def __repr__(self):
+        return f"Histogram({self.name}: n={self._count})"
+
+
 class Registry:
     """Process-wide name -> metric map. Creation is locked; lookups of an
     existing metric are a plain dict get (readers never block writers for
@@ -160,6 +245,9 @@ class Registry:
 
     def timer(self, name) -> Timer:
         return self._get(name, Timer)
+
+    def histogram(self, name) -> Histogram:
+        return self._get(name, Histogram)
 
     def snapshot(self) -> dict:
         """Plain-value view: {name: int|float|(total, count)}."""

@@ -905,3 +905,26 @@ def test_onnx_reductions_roundtrip(tmp_path):
     blk = mxonnx.import_to_gluon(path)
     for got, ref in zip(blk(x), refs):
         assert_almost_equal(got.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_proto_chunked_writer_matches_joined():
+    """The zero-copy chunk writers (w_bytes_header / w_msg_parts, used for
+    multi-hundred-MB initializers) must emit byte-identical wire format to
+    the plain joined writers, above and below the big-field threshold."""
+    from mxnet_tpu.contrib.onnx import _proto as P
+
+    for payload in (b"x" * 17, b"y" * (P._BIG_FIELD + 3)):
+        joined = P.w_bytes(9, payload)
+        parts = [P.w_bytes_header(9, len(payload)), memoryview(payload)]
+        assert b"".join(parts) == joined
+        wrapped = P.w_msg(5, joined)
+        assert b"".join(P.w_msg_parts(5, [joined])) == wrapped
+        # reader side: big length-delimited values come back as zero-copy
+        # memoryviews, small ones as bytes
+        (field, wire, value), = list(P.iter_fields(joined))
+        assert (field, wire) == (9, 2)
+        if len(payload) >= P._BIG_FIELD:
+            assert isinstance(value, memoryview)
+        else:
+            assert isinstance(value, bytes)
+        assert bytes(value) == payload

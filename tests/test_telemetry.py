@@ -78,6 +78,68 @@ def test_reset_keeps_hot_references_valid():
     assert tm.counter("t.reset").value == 2
 
 
+# -- histogram (ISSUE 4 satellite: percentile metrics for serving) ----------
+def test_histogram_nearest_rank_percentiles():
+    h = tm.histogram("t.hist")
+    for v in range(1, 101):  # 1..100, one sample per percent
+        h.record(v)
+    assert h.percentile(50) == 50
+    assert h.percentile(99) == 99
+    assert h.percentile(100) == 100
+    assert h.percentile(0) == 1           # min rank clamps to the smallest
+    assert h.percentiles(50, 90, 99) == [50, 90, 99]
+    assert h.count == 100 and h.sum == 5050 and h.mean == 50.5
+    snap = h.value
+    assert snap["count"] == 100 and snap["p50"] == 50 and snap["p99"] == 99
+
+
+def test_histogram_empty_reset_and_bounds():
+    h = tm.histogram("t.hist.empty")
+    assert h.percentile(50) is None
+    assert h.percentiles(1, 99) == [None, None]
+    h.record(3.5)
+    with pytest.raises(MXNetError):
+        h.percentile(101)
+    with pytest.raises(MXNetError):
+        h.percentile(-1)
+    h.reset()
+    assert h.count == 0 and h.sum == 0.0 and h.percentile(50) is None
+    h.record(7.0)  # the reset object keeps feeding the registry
+    assert tm.histogram("t.hist.empty").percentile(50) == 7.0
+
+
+def test_histogram_window_bounds_memory_but_count_is_exact():
+    from mxnet_tpu.telemetry.registry import Histogram
+
+    h = Histogram("t.hist.window", capacity=64)
+    for v in range(1000):
+        h.record(v)
+    assert h.count == 1000          # exact running count survives eviction
+    assert len(h._buf) == 64        # ring stays bounded
+    assert h.percentile(100) == 999  # window covers the most RECENT samples
+    assert h.percentile(0) >= 1000 - 64
+
+
+def test_histogram_type_mismatch_and_thread_safety():
+    tm.counter("t.hist.clash")
+    with pytest.raises(MXNetError):
+        tm.histogram("t.hist.clash")
+    h = tm.histogram("t.hist.threads")
+    N, THREADS = 5_000, 8
+
+    def work():
+        for _ in range(N):
+            h.record(1.0)
+
+    threads = [threading.Thread(target=work) for _ in range(THREADS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert h.count == N * THREADS
+    assert abs(h.sum - N * THREADS) < 1e-6
+
+
 # -- disabled mode ----------------------------------------------------------
 def test_disabled_mode_is_noop():
     assert not tm.is_enabled()
