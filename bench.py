@@ -4,7 +4,8 @@ Default metric mirrors the reference's headline benchmark
 (example/image-classification/benchmark_score.py; docs/.../faq/perf.md —
 V100 fp16 ResNet-50 batch 128: 2355.04 img/s, BASELINE.md). Select with
 argv[1] or BENCH env: resnet (default) | resnet_train | train_step |
-lstm_lm | bert_pretrain | bert_large_pretrain | optimizer_step |
+train_step_sharded (or ``train_step --shard-update``) | lstm_lm |
+bert_pretrain | bert_large_pretrain | optimizer_step |
 telemetry_overhead | serve.
 
 Robustness contract (round-1 postmortem): any failure — backend init,
@@ -223,6 +224,91 @@ def bench_train_step():
             "dispatches_per_step": disp,
             "recompiles_after_warmup": recomp,
             "compiled_programs": step._traces,
+            "mfu": None}
+
+
+def bench_train_step_sharded():
+    """ZeRO-1 sharded weight update (``compile_step(..., shard_update=True)``)
+    against the replicated update on the same dp mesh, Adam on an MLP.
+    Both settings dispatch the same compiled program (the parity contract),
+    so steps/s should match within noise; the win is optimizer-state
+    memory. Reports sharded steps/s, the sharded/replicated ratio,
+    per-replica vs replicated optimizer-state bytes (from the telemetry
+    gauges), and per-step collective bytes. Select with
+    ``bench.py train_step --shard-update``. BENCH_TRAIN_STEP_SMALL=1
+    shrinks the model/iterations for the not-slow suite."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    small = os.environ.get("BENCH_TRAIN_STEP_SMALL", "") == "1"
+    B, H, WARMUP, ITERS = (32, 64, 2, 10) if small else (256, 1024, 3, 30)
+    mesh = make_mesh()  # every local device on the dp axis
+    n_dp = mesh.shape["dp"]
+    if n_dp < 2:
+        raise RuntimeError(f"sharded update needs dp >= 2, have {n_dp}")
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.standard_normal((B, H)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 10, (B,)).astype("float32"))
+
+    def run(shard):
+        mx.random.seed(7)
+        net = nn.Sequential()
+        net.add(nn.Dense(H, activation="relu"),
+                nn.Dense(H, activation="relu"), nn.Dense(10))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-3})
+        step = tr.compile_step(net, loss_fn, mesh=mesh, shard_update=shard)
+        if step.fallback_reason is not None:
+            raise RuntimeError("compile_step fell back: "
+                               + step.fallback_reason)
+        for _ in range(WARMUP):
+            _sync(step(x, y)._data)
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = step(x, y)
+        _sync(loss._data)
+        return step, ITERS / (time.perf_counter() - t0)
+
+    step_s, sharded_sps = run(True)
+    _, replicated_sps = run(False)
+
+    # the state-bytes gauges are sampled once at build time — read them
+    # before the accounting reset below wipes them
+    per_replica = telemetry.gauge(
+        "train_step.opt_state_bytes_per_replica").value
+    replicated = telemetry.gauge(
+        "train_step.opt_state_bytes_replicated").value
+
+    # accounting pass AFTER the timed loops: telemetry on, a few sharded
+    # steps, read per-step dispatch and collective traffic
+    was_on = telemetry.is_enabled()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        for _ in range(3):
+            _sync(step_s(x, y)._data)
+        rows = telemetry.step_report()
+    finally:
+        telemetry.enable() if was_on else telemetry.disable()
+    disp = max(r["dispatches"] for r in rows) if rows else -1
+    recomp = sum(r["recompiles"] for r in rows) if rows else -1
+    coll = max(r["collective_bytes"] for r in rows) if rows else -1
+    return {"metric": "train_step_sharded_update_mlp",
+            "value": round(sharded_sps, 2), "unit": "steps/s",
+            "vs_baseline": round(sharded_sps / max(replicated_sps, 1e-9), 3),
+            "replicated_steps_per_sec": round(replicated_sps, 2),
+            "dp_size": n_dp,
+            "opt_state_bytes_per_replica": int(per_replica),
+            "opt_state_bytes_replicated": int(replicated),
+            "collective_bytes_per_step": int(coll),
+            "dispatches_per_step": disp,
+            "recompiles_after_warmup": recomp,
+            "compiled_programs": step_s._traces,
             "mfu": None}
 
 
@@ -623,6 +709,8 @@ def _accel_expected():
 def main():
     which = (sys.argv[1] if len(sys.argv) > 1 else
              os.environ.get("BENCH", "resnet"))
+    if which == "train_step" and "--shard-update" in sys.argv[2:]:
+        which = "train_step_sharded"
     import functools
 
     result = {"metric": which, "value": 0.0, "unit": "",
@@ -631,6 +719,7 @@ def main():
         fn = {"resnet": bench_resnet_infer,
               "resnet_train": bench_resnet_train,
               "train_step": bench_train_step,
+              "train_step_sharded": bench_train_step_sharded,
               "lstm_lm": bench_lstm_lm,
               "bert_pretrain": bench_bert_pretrain,
               "bert_large_pretrain": functools.partial(bench_bert_pretrain,

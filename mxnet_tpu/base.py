@@ -10,11 +10,36 @@ Reference: python/mxnet/base.py, 3rdparty/dmlc-core registry pattern.
 """
 from __future__ import annotations
 
+import threading
+import warnings as _warnings
+
 import numpy as onp
 
-__all__ = ["MXNetError", "Registry", "canonical_dtype", "dtype_name", "string_types"]
+__all__ = ["MXNetError", "Registry", "canonical_dtype", "dtype_name",
+           "string_types", "warn_once"]
 
 string_types = (str,)
+
+# process-level dedup for fallback/degradation warnings: hot paths may hit
+# the same unsupported configuration every step (or rebuild their wrapper
+# object every epoch), and the useful signal is "this run degraded", once
+_warned_keys: set = set()
+_warned_lock = threading.Lock()
+
+
+def warn_once(key, message, category=RuntimeWarning, stacklevel=2):
+    """Emit ``message`` at most once per process for ``key``.
+
+    Returns True when the warning fired. Used by the compiled-train-step
+    fallbacks (and anything else that degrades gracefully) so repeated
+    steps — or repeated ``compile_step`` calls on the same net — produce
+    ONE warning per (reason, subject), not one per call."""
+    with _warned_lock:
+        if key in _warned_keys:
+            return False
+        _warned_keys.add(key)
+    _warnings.warn(message, category, stacklevel=stacklevel + 1)
+    return True
 
 
 class MXNetError(RuntimeError):

@@ -58,8 +58,9 @@ class Speedometer:
         disp = sum(r["dispatches"] for r in rows)
         rec = sum(r["recompiles"] for r in rows)
         comm = sum(r["comm_bytes"] for r in rows)
+        coll = sum(r.get("collective_bytes", 0) for r in rows)
         return (f"\tdispatches={disp}\trecompiles={rec}"
-                f"\tcomm={comm}B")
+                f"\tcomm={comm}B\tcollective={coll}B")
 
     def __call__(self, param):
         if self.sync:

@@ -63,6 +63,7 @@ class Trainer:
         self._fused_traces = 0     # trace-time count: observes recompiles
         self._fused_dispatches = 0 # compiled-program calls made by fusion
         self._compiled_step = None # CompiledTrainStep from compile_step()
+        self._shard_state = None   # ZeRO-1 sharded optimizer-state buckets
 
     # -- properties ---------------------------------------------------------
     @property
@@ -77,17 +78,28 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     # -- whole-step compilation ---------------------------------------------
-    def compile_step(self, net, loss_fn, mesh=None, loss_scaler=None):
+    def compile_step(self, net, loss_fn, mesh=None, loss_scaler=None,
+                     shard_update=None, strict_batch=False):
         """Compile forward + loss + backward (+ mesh allreduce) + update into
         ONE donated-buffer program; returns the CompiledTrainStep, also
         exposed as ``self.step_fn``. Semantics of the compiled callable match
         the eager loop ``loss_fn(net(x), y).mean(); backward(); step(1)``.
         Unsupported configurations fall back to that eager loop with a
-        one-time warning (see CompiledTrainStep.fallback_reason)."""
+        one-time warning (see CompiledTrainStep.fallback_reason).
+
+        ``shard_update`` selects the ZeRO-1 cross-replica sharded weight
+        update (reduce-scatter grads, update 1/N shard with 1/N-sharded
+        optimizer state, all-gather weights — bit-identical to the
+        replicated update). ``None`` = auto: on when ``mesh`` carries a
+        'dp' axis of size >= 2 and the optimizer's recurrence is
+        elementwise; ``MXTPU_SHARD_UPDATE=0/1`` overrides. ``strict_batch``
+        restores the hard error for batches not divisible by the dp extent
+        instead of in-program zero-weight padding."""
         from ..train_step import CompiledTrainStep
 
         self._compiled_step = CompiledTrainStep(
-            self, net, loss_fn, mesh=mesh, loss_scaler=loss_scaler)
+            self, net, loss_fn, mesh=mesh, loss_scaler=loss_scaler,
+            shard_update=shard_update, strict_batch=strict_batch)
         return self._compiled_step
 
     @property
@@ -355,8 +367,13 @@ class Trainer:
         """Reference: trainer.py:482."""
         import pickle
 
+        # sharded-update mode: the state lives as dp-sharded flat buckets;
+        # gather back to the per-param layout so the file format (and any
+        # later load into a replicated run) is unchanged
+        states = self._shard_state.gather_states() if self._shard_state \
+            else self._states
         payload = []
-        for st in self._states:
+        for st in states:
             if st is None:
                 payload.append(None)
             else:
@@ -378,3 +395,6 @@ class Trainer:
                         for st in payload["states"]]
         self._optimizer.num_update = payload["num_update"]
         self._optimizer._index_update_count = payload["index_count"]
+        if self._shard_state is not None:
+            # re-shard the freshly loaded full states (consumes _states)
+            self._shard_state.scatter_from_trainer()

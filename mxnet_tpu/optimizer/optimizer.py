@@ -209,6 +209,16 @@ class Optimizer:
         multi-tensor path, or None when this optimizer cannot be fused."""
         return self._fusable
 
+    @property
+    def supports_sharded_update(self):
+        """True when the registered recurrence can run on a 1/N flat shard
+        of the parameter bucket — i.e. it is fusable AND elementwise. The
+        ZeRO-1 sharded weight update concatenates parameters into flat
+        per-dtype buckets and updates only each replica's contiguous slice;
+        per-tensor reductions (LAMB/LARS trust ratios, GroupAdaGrad row
+        sums) would need the whole tensor and keep the replicated path."""
+        return self._fusable is not None and self._fusable[3]
+
     def _apply(self, weight, grad, state, lr, wd, t):
         spec = self._step_spec
         if spec is None:

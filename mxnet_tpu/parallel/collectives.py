@@ -10,7 +10,7 @@ import jax
 from jax import lax
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
-           "all_to_all", "axis_index", "axis_size"]
+           "all_to_all", "axis_index", "axis_size", "BucketSpec"]
 
 
 def all_reduce(x, axis_name, op="sum"):
@@ -40,6 +40,77 @@ def ppermute(x, axis_name, perm):
 
 def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
     return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)
+
+
+class BucketSpec:
+    """Flatten/pad layout for sharding a list of tensors over a mesh axis.
+
+    The ZeRO-1 weight-update schedule (Xu et al., "Automatic Cross-Replica
+    Sharding of Weight Update") works on FLAT per-dtype buckets: tensors are
+    concatenated, padded up to a multiple of the axis size, reduce-scattered
+    so each replica owns a contiguous 1/N shard, updated shard-locally, and
+    all-gathered back. This object is the static layout arithmetic shared by
+    the trace-time body and the host-side state manager: sizes/offsets per
+    tensor, the padded total, and the per-replica shard length.
+    """
+
+    __slots__ = ("shapes", "sizes", "offsets", "total", "padded", "n_shards",
+                 "shard")
+
+    def __init__(self, shapes, n_shards):
+        self.shapes = [tuple(int(d) for d in s) for s in shapes]
+        self.sizes = []
+        for s in self.shapes:
+            n = 1
+            for d in s:
+                n *= d
+            self.sizes.append(n)
+        self.offsets = []
+        off = 0
+        for n in self.sizes:
+            self.offsets.append(off)
+            off += n
+        self.total = off
+        self.n_shards = int(n_shards)
+        self.padded = -(-self.total // self.n_shards) * self.n_shards
+        self.shard = self.padded // self.n_shards
+
+    @property
+    def pad(self):
+        return self.padded - self.total
+
+    def flatten(self, xs, pad_value=0):
+        """Concatenate ``xs`` (matching ``shapes``) into one padded flat
+        vector. Traceable (jnp) — used inside the compiled step body."""
+        import jax.numpy as jnp
+
+        flat = jnp.concatenate([x.reshape(-1) for x in xs]) if len(xs) > 1 \
+            else xs[0].reshape(-1)
+        if self.pad:
+            flat = jnp.pad(flat, (0, self.pad), constant_values=pad_value)
+        return flat
+
+    def unflatten(self, flat):
+        """Split a padded flat vector back into tensors of ``shapes``
+        (discards the pad tail)."""
+        return [flat[o:o + n].reshape(s)
+                for o, n, s in zip(self.offsets, self.sizes, self.shapes)]
+
+    def spread(self, per_tensor, pad_value=0.0):
+        """Per-tensor scalars -> per-element flat vector (padded). Static
+        repeat lengths, so this never retraces on value changes."""
+        import jax.numpy as jnp
+
+        v = jnp.repeat(per_tensor, jnp.asarray(self.sizes),
+                       total_repeat_length=self.total)
+        if self.pad:
+            v = jnp.pad(v, (0, self.pad), constant_values=pad_value)
+        return v
+
+    def shard_slice(self, flat, axis_name):
+        """This replica's contiguous 1/N slice of a padded flat vector."""
+        idx = lax.axis_index(axis_name)
+        return lax.dynamic_slice_in_dim(flat, idx * self.shard, self.shard)
 
 
 def axis_index(axis_name):

@@ -15,7 +15,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 
 __all__ = ["AxisNames", "make_mesh", "default_mesh", "replicated",
-           "shard_batch", "shard_params", "shard_map_compat", "P"]
+           "shard_batch", "shard_params", "shard_map_compat", "P",
+           "shard_1d", "zeros_sharded", "axis_extent"]
 
 
 class AxisNames:
@@ -87,6 +88,33 @@ def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
 
     return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                check_rep=False)
+
+
+def shard_1d(mesh: Mesh, axis: str = AxisNames.DP) -> NamedSharding:
+    """Shard a flat (1-D) buffer over ``axis`` — the layout of the ZeRO-1
+    optimizer-state buckets (each replica owns one contiguous 1/N slice)."""
+    return NamedSharding(mesh, P(axis))
+
+
+def axis_extent(mesh: Mesh, axis: str) -> int:
+    """Size of ``axis`` in ``mesh`` (0 when the axis is absent)."""
+    return int(mesh.shape.get(axis, 0))
+
+
+def zeros_sharded(mesh: Mesh, shape, dtype, spec) -> jax.Array:
+    """Allocate zeros directly under ``NamedSharding(mesh, spec)``.
+
+    The allocation happens INSIDE a jitted program with an output sharding
+    constraint, so no replica ever materializes the full buffer — each
+    device writes only its shard. This is how the sharded weight update
+    gets optimizer state that is 1/N-sized from the very first step, not
+    full-sized-then-resharded.
+    """
+    sharding = NamedSharding(mesh, spec)
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
+    return fn()
 
 
 def shard_params(mesh: Mesh, spec_fn=None):

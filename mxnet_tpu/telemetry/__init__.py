@@ -58,6 +58,12 @@ _C_COMPILES = REGISTRY.counter("jit.compiles")
 _C_RECOMPILES = REGISTRY.counter("jit.recompiles")
 _C_PUSH_BYTES = REGISTRY.counter("kvstore.push_bytes")
 _C_PULL_BYTES = REGISTRY.counter("kvstore.pull_bytes")
+# in-program collective traffic (reduce_scatter / all_gather / psum): the
+# collectives run inside compiled programs where the host cannot observe
+# them, so the dispatch sites report the statically-known per-call bytes
+_C_RS_BYTES = REGISTRY.counter("collective.reduce_scatter_bytes")
+_C_AG_BYTES = REGISTRY.counter("collective.all_gather_bytes")
+_C_PSUM_BYTES = REGISTRY.counter("collective.psum_bytes")
 
 
 # -- gating -----------------------------------------------------------------
@@ -157,7 +163,8 @@ def mark_step(name=None):
 
 def step_report(reset=False):
     """One dict per marked step: {step, dispatches, compiles, recompiles,
-    comm_bytes, kvstore_push_bytes, kvstore_pull_bytes, host_time: {...}}."""
+    comm_bytes, kvstore_push_bytes, kvstore_pull_bytes, collective_bytes,
+    reduce_scatter_bytes, all_gather_bytes, psum_bytes, host_time: {...}}."""
     return STEPS.report(reset=reset)
 
 
@@ -213,6 +220,22 @@ def record_comm(push_bytes=0, pull_bytes=0):
         _C_PUSH_BYTES.inc(push_bytes)
     if pull_bytes:
         _C_PULL_BYTES.inc(pull_bytes)
+
+
+def record_collective(reduce_scatter_bytes=0, all_gather_bytes=0,
+                      psum_bytes=0):
+    """Count in-program collective traffic (per-replica payload bytes).
+
+    Called at dispatch time with the statically-known sizes of the
+    collectives a compiled program contains — XLA executes them where the
+    host cannot count, but the program's schedule is fixed at trace time.
+    Callers guard on ``telemetry.ON``."""
+    if reduce_scatter_bytes:
+        _C_RS_BYTES.inc(reduce_scatter_bytes)
+    if all_gather_bytes:
+        _C_AG_BYTES.inc(all_gather_bytes)
+    if psum_bytes:
+        _C_PSUM_BYTES.inc(psum_bytes)
 
 
 def compile_count():

@@ -23,6 +23,9 @@ _ROW_COUNTERS = {
     "recompiles": "jit.recompiles",
     "kvstore_push_bytes": "kvstore.push_bytes",
     "kvstore_pull_bytes": "kvstore.pull_bytes",
+    "reduce_scatter_bytes": "collective.reduce_scatter_bytes",
+    "all_gather_bytes": "collective.all_gather_bytes",
+    "psum_bytes": "collective.psum_bytes",
 }
 
 _MAX_ROWS = 100_000  # bound memory over arbitrarily long runs
@@ -71,6 +74,9 @@ class StepTracker:
                 prev[col] = v
             row["comm_bytes"] = (row["kvstore_push_bytes"] +
                                  row["kvstore_pull_bytes"])
+            row["collective_bytes"] = (row["reduce_scatter_bytes"] +
+                                       row["all_gather_bytes"] +
+                                       row["psum_bytes"])
             host = {}
             for t in self._timers:
                 tot = t._total

@@ -2,7 +2,7 @@
 
 TPU-native analog of the reference CachedOp's graph-level bulking (and of
 PyGraph's whole-iteration CUDA-graph capture): forward + loss + backward +
-gradient rescale + (under a mesh) the data-parallel all-reduce + the
+gradient rescale + (under a mesh) the data-parallel reduction + the
 registered optimizer recurrence trace into ONE ``jax.jit`` program with the
 weight and optimizer-state buffers donated. Steady state is exactly one host
 dispatch per step; the loss scalar (and BN moving-stat write-backs) are the
@@ -18,7 +18,7 @@ Reuses the existing pieces instead of duplicating them:
 - the update unrolls ``Optimizer._register_step``'s pure per-tensor
   recurrence (the PR-1 declaration) per parameter;
 - the data-parallel path runs the body under ``shard_map`` and reduces
-  gradients with ``parallel.collectives.all_reduce``.
+  gradients with ``parallel.collectives``.
 
 Hyper-parameters (lr / wd / t / rescale / loss scale) ride as RUNTIME
 operands — an LR schedule or a ``DynamicLossScaler`` causes zero recompiles.
@@ -26,12 +26,45 @@ With a loss scaler the program additionally returns an overflow flag
 computed in-program (finiteness of the scaled gradients); on overflow the
 update is a ``where``-select no-op and the host skips the schedule commit,
 matching the eager skip-on-overflow loop.
+
+Sharded weight update (ZeRO-1)
+------------------------------
+With a data-parallel mesh the replicated schedule runs the *identical*
+optimizer update on every replica — weight-update FLOPs and optimizer state
+(2x weights for Adam) duplicated N ways. ``shard_update`` (auto-on when the
+mesh's 'dp' axis has >= 2 shards and the optimizer's recurrence is
+elementwise) applies the schedule of Xu et al., "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training": the grad ``pmean``
+becomes a ``reduce_scatter`` over flat per-dtype parameter buckets (padded
+to a multiple of the dp extent), the recurrence runs only on each replica's
+contiguous 1/N shard with optimizer state ALLOCATED sharded from
+initialization, and an ``all_gather`` rebuilds the full weights — all
+inside the same single donated-buffer program, where XLA overlaps the
+collectives with the update on ICI. Per-replica update FLOPs and optimizer
+state drop ~Nx.
+
+Bit parity: with an elementwise optimizer, BOTH ``shard_update`` settings
+dispatch the SAME compiled program — the ZeRO-1 schedule above, with state
+entering as dp-sharded buckets. They differ only in state RESIDENCY
+between steps: sharded keeps the persistent 1/N shard buckets (the memory
+win), replicated keeps the classic per-param arrays in
+``trainer._states`` and reshards them around each dispatch (inspectable
+state and the pre-existing checkpoint layout, at the cost of one state
+scatter + gather per step). Identical program + identical inputs means
+bitwise-identical weights, unconditionally. Structurally different
+sharded/replicated programs do NOT give that: XLA's global layout and
+fusion passes then round a few gradient elements differently (1 ulp,
+input-dependent), and neither ``optimization_barrier`` (expanded away
+before fusion) nor ``reduce_precision`` pinning (reassociated across, and
+a no-op in the CPU emitter) recovers parity. Non-elementwise fused
+optimizers (trust-ratio / whole-tensor reductions) keep the per-tensor
+psum update, replicated on every device.
 """
 from __future__ import annotations
 
-import warnings
+import os
 
-from .base import MXNetError
+from .base import MXNetError, warn_once
 from . import telemetry as _telemetry
 
 __all__ = ["CompiledTrainStep"]
@@ -40,13 +73,149 @@ __all__ = ["CompiledTrainStep"]
 class _Program:
     """One compiled step program + the trace metadata needed to drive it."""
 
-    __slots__ = ("fn", "uses_rng", "aux_targets", "n_aux")
+    __slots__ = ("fn", "uses_rng", "aux_targets", "n_aux", "sharded",
+                 "coll_bytes")
 
-    def __init__(self, fn, uses_rng, aux_targets):
+    def __init__(self, fn, uses_rng, aux_targets, sharded=False,
+                 coll_bytes=(0, 0, 0)):
         self.fn = fn
         self.uses_rng = uses_rng
         self.aux_targets = aux_targets
         self.n_aux = len(aux_targets)
+        self.sharded = sharded
+        # (reduce_scatter, all_gather, psum) bytes per call, known at build
+        # time — the host's only window into in-program collective traffic
+        self.coll_bytes = coll_bytes
+
+
+class _ShardedOptState:
+    """ZeRO-1 optimizer state: flat per-dtype buckets sharded over 'dp'.
+
+    Each state key of each bucket is ONE global ``(padded,)`` f32
+    ``jax.Array`` under ``NamedSharding(mesh, P('dp'))`` — every replica
+    materializes only its contiguous 1/N shard, from the very first
+    allocation (``parallel.mesh.zeros_sharded``). While this is live it is
+    the source of truth: the trainer's per-param ``_states`` stay ``None``
+    and checkpoints gather back to the per-param layout (identical pickle
+    format to the replicated path) and re-scatter on load.
+
+    Gathering assumes all shards are addressable by this process (single
+    controller / host-platform mesh); a multi-host checkpoint would use a
+    distributed array serializer instead.
+    """
+
+    def __init__(self, mesh, opt, trainer, train_idx, buckets, state_keys):
+        self.mesh = mesh
+        self.opt = opt
+        self.trainer = trainer
+        self.train_idx = train_idx
+        self.buckets = buckets          # [(dtype_str, ks, BucketSpec)]
+        self.state_keys = state_keys
+        self.state = []                 # per bucket: tuple over keys
+        self._init()
+        # gauges are samples, set once per build — no ON guard needed
+        _telemetry.gauge("train_step.opt_state_bytes_per_replica").set(
+            self.per_replica_state_bytes())
+        _telemetry.gauge("train_step.opt_state_bytes_replicated").set(
+            self.replicated_state_bytes())
+
+    # -- allocation ---------------------------------------------------------
+    def _init(self):
+        from .parallel.mesh import zeros_sharded, P
+        import jax.numpy as jnp
+
+        tr, keys = self.trainer, self.state_keys
+        for _, ks, bs in self.buckets:
+            if not keys:
+                self.state.append(())
+                continue
+            idxs = [self.train_idx[k] for k in ks]
+            if all(tr._states[i] is None for i in idxs):
+                # fresh run: allocate zeros DIRECTLY sharded — no replica
+                # ever holds the full state (every registered elementwise
+                # recurrence zero-initializes its state)
+                self.state.append(tuple(
+                    zeros_sharded(self.mesh, (bs.padded,), jnp.float32,
+                                  P("dp"))
+                    for _ in keys))
+            else:
+                # resumed/mixed: scatter the existing full state
+                for i in idxs:
+                    if tr._states[i] is None:
+                        tr._states[i] = \
+                            self.opt.create_state_multi_precision(
+                                i, tr._params[i].data())
+                self.state.append(self._scatter_bucket(ks, bs))
+                for i in idxs:
+                    tr._states[i] = None  # sharded buckets own it now
+
+    def _scatter_bucket(self, ks, bs):
+        import jax
+        import numpy as onp
+        from .parallel.mesh import shard_1d
+
+        tr = self.trainer
+        sharding = shard_1d(self.mesh)
+        out = []
+        for key in self.state_keys:
+            flat = onp.zeros((bs.padded,), onp.float32)
+            for k, off, n in zip(ks, bs.offsets, bs.sizes):
+                st = tr._states[self.train_idx[k]]
+                flat[off:off + n] = st[key].asnumpy().reshape(-1)
+            out.append(jax.device_put(flat, sharding))
+        return tuple(out)
+
+    # -- step rebind --------------------------------------------------------
+    def rebind(self, new_state):
+        """Adopt the program's donated-output state buckets."""
+        self.state = [tuple(st) for st in new_state]
+
+    # -- checkpoint bridge --------------------------------------------------
+    def gather_states(self):
+        """Per-param full state dicts (the replicated pickle layout)."""
+        import numpy as onp
+        from .ndarray.ndarray import NDArray
+
+        out = [None] * len(self.trainer._params)
+        for (_, ks, bs), st in zip(self.buckets, self.state):
+            for key, arr in zip(self.state_keys, st):
+                flat = onp.asarray(arr)  # gathers every shard to host
+                for k, off, n, shape in zip(ks, bs.offsets, bs.sizes,
+                                            bs.shapes):
+                    i = self.train_idx[k]
+                    if out[i] is None:
+                        out[i] = {}
+                    out[i][key] = NDArray(flat[off:off + n].reshape(shape))
+        return out
+
+    def scatter_from_trainer(self):
+        """Re-shard after ``Trainer.load_states`` refilled ``_states``."""
+        tr = self.trainer
+        state = []
+        for _, ks, bs in self.buckets:
+            idxs = [self.train_idx[k] for k in ks]
+            for i in idxs:
+                if tr._states[i] is None:
+                    tr._states[i] = self.opt.create_state_multi_precision(
+                        i, tr._params[i].data())
+            state.append(self._scatter_bucket(ks, bs))
+            for i in idxs:
+                tr._states[i] = None
+        self.state = state
+
+    # -- accounting ---------------------------------------------------------
+    def per_replica_state_bytes(self):
+        """Bytes of optimizer state ONE replica holds (its shards)."""
+        total = 0
+        for st in self.state:
+            for arr in st:
+                total += arr.addressable_shards[0].data.nbytes
+        return total
+
+    def replicated_state_bytes(self):
+        """What the replicated path would hold per replica (full state)."""
+        return sum(bs.total * 4 * len(self.state_keys)
+                   for _, _, bs in self.buckets)
 
 
 class CompiledTrainStep:
@@ -57,16 +226,31 @@ class CompiledTrainStep:
     — the loss is batch-normalized by the ``.mean()``, so the optimizer's
     ``rescale_grad`` is applied as-is (no per-call batch division).
 
+    ``shard_update`` (default: auto-on when the mesh carries a 'dp' axis of
+    size >= 2 and the optimizer's recurrence is elementwise; forced by
+    ``MXTPU_SHARD_UPDATE=0/1``) runs the ZeRO-1 reduce-scatter →
+    shard-update → all-gather schedule with 1/N-sharded optimizer state —
+    see the module docstring. Unsupported configurations keep the replicated
+    in-program update with a one-time warning
+    (reason in ``.shard_fallback_reason``).
+
+    A batch not divisible by the dp extent is padded IN-PROGRAM with
+    zero-example-weight rows (the loss becomes the weighted mean over the
+    real rows, so gradients and the loss value match the unpadded batch);
+    ``strict_batch=True`` restores the hard error. Note each distinct
+    trailing-batch shape compiles its own program, and BatchNorm batch
+    statistics do see the padded rows.
+
     Falls back to the eager record/backward/``Trainer.step`` path (with a
-    one-time warning, reason in ``.fallback_reason``) when the step cannot
-    soundly compile: optimizer without a registered fusable recurrence
-    (e.g. SGLD's host RNG), ``multi_precision`` master weights,
+    one-time warning per (reason, net), reason in ``.fallback_reason``) when
+    the step cannot soundly compile: optimizer without a registered fusable
+    recurrence (e.g. SGLD's host RNG), ``multi_precision`` master weights,
     ``update_on_kvstore``, a multi-worker kvstore (gradients reduce outside
     the program), or non-float trainables.
     """
 
     def __init__(self, trainer, net, loss_fn, mesh=None, loss_scaler=None,
-                 name="train_step"):
+                 name="train_step", shard_update=None, strict_batch=False):
         self.trainer = trainer
         self.net = net
         self.loss_fn = loss_fn
@@ -74,15 +258,21 @@ class CompiledTrainStep:
         self.loss_scaler = loss_scaler if loss_scaler is not None \
             else getattr(trainer, "_amp_loss_scaler", None)
         self.name = name
+        self.strict_batch = strict_batch
         self.fallback_reason = None
-        self._warned = False
+        self.shard_update = False
+        self.shard_fallback_reason = None
+        self._shard_state = None
         self._cache = {}       # input signature -> _Program
         self._train_idx = None
         self._frozen = None
         self._state_keys = ()
+        self._buckets = None
+        self._state_bucket_bytes = 0
         self._traces = 0       # trace-time count (observes recompiles)
         self._dispatches = 0   # compiled-program calls
         self._check_supported()
+        self._resolve_shard_update(shard_update)
 
     # -- support matrix -----------------------------------------------------
     def _check_supported(self):
@@ -116,26 +306,74 @@ class CompiledTrainStep:
                     f"compile_step mesh must carry a '{AxisNames.DP}' axis; "
                     f"got {self.mesh.axis_names}")
 
+    def _dp_size(self):
+        if self.mesh is None:
+            return 0
+        from .parallel.mesh import AxisNames
+
+        return int(self.mesh.shape[AxisNames.DP])
+
+    def _resolve_shard_update(self, requested):
+        """Decide the update schedule. ``MXTPU_SHARD_UPDATE=0/1`` overrides
+        the argument; ``None`` = auto (on when shardable). A shard request
+        the configuration cannot honor keeps the REPLICATED compiled path
+        (not the eager fallback) and warns once per (reason, net)."""
+        env = os.environ.get("MXTPU_SHARD_UPDATE")
+        if env is not None:
+            requested = env.strip().lower() not in ("0", "false", "off", "")
+        auto = requested is None
+        if requested is False:
+            return
+        if self.fallback_reason is not None:
+            return  # the whole step already falls back to eager
+        opt = self.trainer._optimizer
+        n = self._dp_size()
+        if n < 2:
+            reason = "no mesh with a 'dp' axis of size >= 2"
+        elif not opt.supports_sharded_update:
+            reason = (f"{type(opt).__name__}'s recurrence is not "
+                      "elementwise (per-tensor reductions need the full "
+                      "tensor)")
+        else:
+            self.shard_update = True
+            return
+        if auto and self.mesh is None:
+            return  # plain single-device compile: nothing to announce
+        self.shard_fallback_reason = reason
+        warn_once(("shard_update", reason, id(self.net)),
+                  f"compile_step: sharded weight update unavailable — "
+                  f"{reason}; keeping the replicated update", RuntimeWarning)
+
     # -- stepping -----------------------------------------------------------
     def __call__(self, x, y):
         if self.fallback_reason is not None:
             return self._eager_step(x, y)
-        if self.mesh is not None:
-            from .parallel.mesh import AxisNames
-
-            n = self.mesh.shape[AxisNames.DP]
-            if x.shape[0] % n:
-                raise MXNetError(
-                    f"batch {x.shape[0]} not divisible by the mesh's "
-                    f"'{AxisNames.DP}' axis ({n} shards)")
+        pad = self._validate_batch(x)
         sig = (x.shape, str(x.dtype), y.shape, str(y.dtype))
         prog = self._cache.get(sig)
         if prog is None:
-            prog = self._build(x, y)
+            prog = self._build(x, y, pad=pad)
             if prog is None:  # trace discovered an unsupported layout
                 return self._eager_step(x, y)
             self._cache[sig] = prog
         return self._run(prog, x, y)
+
+    def _validate_batch(self, x):
+        """Rows of in-program zero-weight padding needed to even the batch
+        over the dp axis (0 when divisible, or no mesh). With
+        ``strict_batch=True`` a ragged batch raises instead — the pre-pad
+        contract."""
+        if self.mesh is None:
+            return 0
+        n = self._dp_size()
+        r = x.shape[0] % n
+        if r == 0:
+            return 0
+        if self.strict_batch:
+            raise MXNetError(
+                f"batch {x.shape[0]} not divisible by the mesh's "
+                f"'dp' axis ({n} shards) and strict_batch=True")
+        return n - r
 
     # -- tracing ------------------------------------------------------------
     def _collect(self):
@@ -171,9 +409,25 @@ class CompiledTrainStep:
                     f"non-float trainable parameter {tr._params[i].name}"
         return train_idx, frozen, None
 
-    def _build(self, x, y):
+    def _make_buckets(self, train_idx):
+        """Per-dtype flat buckets over the trainables (positions into the
+        train list), padded to the dp extent — the ZeRO-1 layout."""
+        from .parallel.collectives import BucketSpec
+
+        tr = self.trainer
+        n = self._dp_size()
+        by_dt = {}
+        for k, i in enumerate(train_idx):
+            by_dt.setdefault(str(tr._params[i].data().dtype), []).append(k)
+        return [(dt, by_dt[dt],
+                 BucketSpec([tuple(tr._params[train_idx[k]].data().shape)
+                             for k in by_dt[dt]], n))
+                for dt in sorted(by_dt)]
+
+    def _build(self, x, y, pad=0):
         import jax
         import jax.numpy as jnp
+        import numpy as onp
 
         from . import _deferred_compute as dc
         from . import autograd as ag
@@ -181,6 +435,7 @@ class CompiledTrainStep:
 
         tr = self.trainer
         opt = tr._optimizer
+        weighted = pad > 0
         with ag.train_mode():
             if any(p._data is None
                    for p in self.net.collect_params().values()):
@@ -191,11 +446,17 @@ class CompiledTrainStep:
             self.fallback_reason = reason
             return None
         raw, state_keys, needs_t, _ = opt.fused_step
+        sharded = self.shard_update
+        # the flat-bucket ZeRO-1 schedule needs an elementwise recurrence
+        # (it updates arbitrary chunk slices); other fused optimizers keep
+        # the per-tensor psum update on a mesh
+        bucketed = self.mesh is not None and opt.supports_sharded_update
         for i in train_idx:
-            if tr._states[i] is None:
+            if not sharded and tr._states[i] is None:
                 tr._states[i] = opt.create_state_multi_precision(
                     i, tr._params[i].data())
-            if any(k not in tr._states[i] for k in state_keys):
+            if tr._states[i] is not None and \
+                    any(k not in tr._states[i] for k in state_keys):
                 self.fallback_reason = (
                     f"optimizer state for {tr._params[i].name} lacks "
                     f"{state_keys} (restored from an older run?)")
@@ -204,14 +465,48 @@ class CompiledTrainStep:
         self._frozen = frozen
         self._state_keys = state_keys
 
+        # ONE program serves both shard_update settings: the ZeRO-1
+        # schedule with state entering as dp-sharded buckets. The settings
+        # differ only in state RESIDENCY between steps (persistent shards
+        # vs per-param replicated arrays scattered/gathered around the
+        # dispatch), so sharded and replicated trajectories are bitwise
+        # identical by construction — the parity contract
+        buckets = self._make_buckets(train_idx) if bucketed else None
+        self._buckets = buckets
+        self._state_bucket_bytes = sum(
+            bs.padded * 4 for _, _, bs in buckets) * len(state_keys) \
+            if bucketed else 0
+        if sharded and self._shard_state is None:
+            # the sharded state is per-net, not per-program: every input
+            # shape's program reads the same buckets
+            self._shard_state = _ShardedOptState(
+                self.mesh, opt, tr, train_idx, buckets, state_keys)
+            tr._shard_state = self._shard_state
+
         # --- capture the forward+loss graph (the hybridize machinery) ------
+        if weighted:
+            # trace on PADDED shapes; the per-sample loss vector stays
+            # un-meaned so the body can weight out the pad rows
+            x_t = self._pad_rows(x, pad)
+            y_t = self._pad_rows(y, pad)
+        else:
+            x_t, y_t = x, y
         with ag.train_mode(), dc.context() as tctx:
-            dvars = [dc.set_variable(x, "data0"), dc.set_variable(y, "label0")]
+            dvars = [dc.set_variable(x_t, "data0"),
+                     dc.set_variable(y_t, "label0")]
             wvars = [dc.set_variable(tr._params[i].data(), f"w{i}")
                      for i in train_idx]
             fvars = [dc.set_variable(p.data(), pname)
                      for pname, p in frozen]
-            loss = self.loss_fn(self.net(x), y).mean()
+            loss = self.loss_fn(self.net(x_t), y_t)
+            if weighted:
+                if loss.ndim == 0 or loss.shape[0] != x_t.shape[0]:
+                    raise MXNetError(
+                        "partial-batch padding needs a per-sample loss "
+                        f"(got shape {tuple(loss.shape)}); pass batches "
+                        "divisible by the dp axis or strict_batch=True")
+            else:
+                loss = loss.mean()
             if loss._dc_sym is None:
                 self.fallback_reason = \
                     "loss is not connected to the traced forward"
@@ -225,12 +520,14 @@ class CompiledTrainStep:
         n_state = len(state_keys)
         scaler_on = self.loss_scaler is not None
         mesh = self.mesh
+        n_dp = self._dp_size()
         site = f"train_step:{self.name}"
         attrs = (f"n_params={n_train} n_aux={n_aux} "
                  f"opt={type(opt).__name__} scaler={scaler_on} "
-                 f"mesh={mesh is not None}")
+                 f"mesh={mesh is not None} sharded={sharded} pad={pad}")
 
-        def body(ws, ss, fs, xb, yb, key, lrs, wds, ts, rescale, loss_scale):
+        def body(ws, ss, fs, xb, yb, wv, key, lrs, wds, ts, rescale,
+                 loss_scale):
             # executes at TRACE time only: the python loop unrolls into one
             # program, and the observers below count recompiles, not calls
             self._traces += 1
@@ -241,24 +538,68 @@ class CompiledTrainStep:
                 # per-shard dropout masks: fold the shard index into the key
                 key = jax.random.fold_in(key, coll.axis_index("dp"))
 
-            def lfn(w_tuple):
-                args = ([key] if uses_rng else []) + [xb, yb] + \
-                    list(w_tuple) + list(fs)
-                return fwd(*args)
+            if weighted:
+                from .parallel import collectives as coll
 
-            # backward INSIDE the trace, seeded with the loss scale so a
-            # DynamicLossScaler update never retraces (autograd.program_vjp)
-            outs, (grads,) = ag.program_vjp(lfn, (tuple(ws),), loss_scale)
-            loss_v, aux = outs[0], list(outs[1:])
+                # weighted mean over the REAL rows: pad rows carry weight 0,
+                # so loss and gradients match the unpadded batch exactly
+                wsum = jnp.sum(wv)
+                if mesh is not None:
+                    wsum = coll.all_reduce(wsum, "dp", op="sum")
+
+                def lfn(w_tuple):
+                    args = ([key] if uses_rng else []) + [xb, yb] + \
+                        list(w_tuple) + list(fs)
+                    outs = fwd(*args)
+                    return (jnp.sum(outs[0] * wv),) + tuple(outs[1:])
+
+                # cotangent pre-divided by the true example count: local
+                # grads then SUM-reduce to the full gradient
+                outs, (grads,) = ag.program_vjp(lfn, (tuple(ws),),
+                                                loss_scale / wsum)
+                loss_v = outs[0] / wsum
+                aux = list(outs[1:])
+                if mesh is not None:
+                    loss_v = coll.all_reduce(loss_v, "dp", op="sum")
+                grad_op = "sum"
+            else:
+                def lfn(w_tuple):
+                    args = ([key] if uses_rng else []) + [xb, yb] + \
+                        list(w_tuple) + list(fs)
+                    return fwd(*args)
+
+                # backward INSIDE the trace, seeded with the loss scale so a
+                # DynamicLossScaler update never retraces (program_vjp)
+                outs, (grads,) = ag.program_vjp(lfn, (tuple(ws),),
+                                                loss_scale)
+                loss_v, aux = outs[0], list(outs[1:])
+                if mesh is not None:
+                    from .parallel import collectives as coll
+
+                    loss_v = coll.all_reduce(loss_v, "dp", op="mean")
+                grad_op = "mean"
             if mesh is not None:
                 from .parallel import collectives as coll
 
-                # the data-parallel reduction, scheduled by XLA against the
-                # backward it interleaves with (the kvstore pushpull role)
-                grads = tuple(coll.all_reduce(g, "dp", op="mean")
-                              for g in grads)
-                loss_v = coll.all_reduce(loss_v, "dp", op="mean")
                 aux = [coll.all_reduce(a, "dp", op="mean") for a in aux]
+
+            if bucketed:
+                upd = _bucket_update(
+                    ws, ss, grads, lrs, wds, ts, rescale, grad_op)
+                return (loss_v, tuple(aux)) + upd
+            if mesh is not None:
+                from .parallel import collectives as coll
+
+                # non-elementwise recurrence: reduce per tensor, then run
+                # the full-tensor update replicated on every device
+                grads = tuple(coll.all_reduce(g, "dp", op=grad_op)
+                              for g in grads)
+            return (loss_v, tuple(aux)) + _per_tensor_update(
+                ws, ss, grads, lrs, wds, ts, rescale)
+
+        def _per_tensor_update(ws, ss, grads, lrs, wds, ts, rescale):
+            # single-device + non-elementwise-mesh path: the original
+            # per-tensor unroll
             # overflow = non-finite SCALED grads, the quantity the eager
             # LossScaler.has_overflow inspects (before unscale)
             finite = jnp.bool_(True)
@@ -285,20 +626,181 @@ class CompiledTrainStep:
                                for s0, s1 in zip(ss[k], ns))
                 new_ws.append(nw)
                 new_ss.append(ns)
-            return loss_v, tuple(aux), new_ws, new_ss, overflow
+            return new_ws, new_ss, overflow
+
+        def _bucket_update(ws, ss, grads, lrs, wds, ts, rescale, grad_op):
+            """The ZeRO-1 update on flat per-dtype buckets: reduce_scatter
+            the flat gradient, run the recurrence only on this replica's
+            contiguous 1/N shard (state enters and leaves as dp-sharded
+            buckets), all_gather the updated weights — the classic
+            two-phase expansion of an all-reduce, so it pays the bandwidth
+            a psum would. This is the ONLY elementwise mesh update: both
+            ``shard_update`` settings dispatch the same program (and hence
+            the same bits); they differ in state residency handled by the
+            host in ``_run``. Earlier variants compiled a structurally
+            different replicated program — XLA's global layout/fusion
+            passes then make input-dependent 1-ulp rounding differences
+            appear in the gradients, and no amount of per-op pinning
+            (optimization_barrier, reduce_precision) stops it."""
+            from .parallel import collectives as coll
+
+            # reduce each bucket; every replica owns one contiguous slice
+            # of the fully-reduced gradient
+            gred, finite = [], jnp.bool_(True)
+            for _, ks, bs in buckets:
+                flat_g = bs.flatten([grads[k] for k in ks])
+                g = coll.reduce_scatter(flat_g, "dp")
+                if grad_op == "mean":
+                    g = g / n_dp  # pmean == psum / N, elementwise
+                gred.append(g)
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+            # each replica saw only its shards: AND the verdicts so the
+            # where-select (run on shards) agrees everywhere
+            finite = coll.all_reduce(finite.astype(jnp.int32), "dp",
+                                     op="min") > 0
+            overflow = jnp.logical_not(finite)
+            new_ws = [None] * n_train
+            new_ss = []
+            def run_chunk(w_c, st_c, g_c, lr_c, wd_c, t_c):
+                args = [w_c, *st_c, g_c * rescale, lr_c, wd_c]
+                if needs_t:
+                    args.append(t_c)
+                out = raw(*args)
+                if n_state:
+                    nw, ns = out[0], tuple(out[1:])
+                else:
+                    nw, ns = out, ()
+                if scaler_on:
+                    nw = jnp.where(overflow, w_c, nw)
+                    ns = tuple(jnp.where(overflow, s0, s1)
+                               for s0, s1 in zip(st_c, ns))
+                return nw, ns
+
+            for bi, ((_, ks, bs), g) in enumerate(zip(buckets, gred)):
+                ksel = jnp.asarray(ks)
+                w_in = bs.flatten([ws[k] for k in ks])
+                lr_v = bs.spread(lrs[ksel])
+                wd_v = bs.spread(wds[ksel])
+                # pad tail gets t=1 so bias-correction terms stay finite
+                # (the pad region is all-zero and discarded)
+                t_v = bs.spread(ts[ksel], pad_value=1.0) if needs_t else None
+                sl = lambda v: bs.shard_slice(v, "dp")  # noqa: E731
+                nw, ns = run_chunk(sl(w_in), ss[bi], g, sl(lr_v), sl(wd_v),
+                                   sl(t_v) if needs_t else None)
+                flat_nw = coll.all_gather(nw, "dp", axis=0, tiled=True)
+                new_ss.append(ns)
+                for k, arr in zip(ks, bs.unflatten(flat_nw)):
+                    new_ws[k] = arr
+            return new_ws, tuple(new_ss), overflow
 
         fn = body
         if mesh is not None:
             from .parallel.mesh import P, shard_map_compat
 
             dp = P("dp")
-            fn = shard_map_compat(
+            ss_spec = dp if bucketed else P()
+            out_state = dp if bucketed else P()
+            inner = shard_map_compat(
                 body, mesh,
-                in_specs=(P(), P(), P(), dp, dp, P(), P(), P(), P(), P(),
-                          P()),
-                out_specs=P())
+                in_specs=(P(), ss_spec, P(), dp, dp, dp if weighted else P(),
+                          P(), P(), P(), P(), P(), P()),
+                out_specs=(P(), P(), P(), out_state, P()))
+            if weighted:
+                b = int(x.shape[0])
+
+                def padded(ws, ss, fs, xb, yb, key, lrs, wds, ts, rescale,
+                           loss_scale):
+                    # pad IN-PROGRAM: the host hands the ragged batch as-is
+                    xb = jnp.pad(xb, ((0, pad),) + ((0, 0),) * (xb.ndim - 1))
+                    yb = jnp.pad(yb, ((0, pad),) + ((0, 0),) * (yb.ndim - 1))
+                    wv = (jnp.arange(b + pad) < b).astype(jnp.float32)
+                    return inner(ws, ss, fs, xb, yb, wv, key, lrs, wds, ts,
+                                 rescale, loss_scale)
+
+                fn = padded
+            else:
+                def unweighted(ws, ss, fs, xb, yb, key, lrs, wds, ts,
+                               rescale, loss_scale):
+                    wv = jnp.zeros((n_dp,), jnp.float32)  # unused
+                    return inner(ws, ss, fs, xb, yb, wv, key, lrs, wds, ts,
+                                 rescale, loss_scale)
+
+                fn = unweighted
+        else:
+            def no_mesh(ws, ss, fs, xb, yb, key, lrs, wds, ts, rescale,
+                        loss_scale):
+                return body(ws, ss, fs, xb, yb, None, key, lrs, wds, ts,
+                            rescale, loss_scale)
+
+            fn = no_mesh
+        coll_bytes = self._collective_bytes(train_idx, aux_targets, buckets,
+                                            bucketed, weighted, scaler_on)
         return _Program(jax.jit(fn, donate_argnums=(0, 1)), uses_rng,
-                        aux_targets)
+                        aux_targets, sharded=bucketed, coll_bytes=coll_bytes)
+
+    @staticmethod
+    def _pad_rows(arr, pad):
+        """Host-side zero row padding (trace shapes only — runtime padding
+        happens in-program)."""
+        import jax.numpy as jnp
+
+        from .ndarray.ndarray import NDArray
+
+        return NDArray(jnp.pad(
+            arr._data, ((0, pad),) + ((0, 0),) * (arr._data.ndim - 1)))
+
+    def _collective_bytes(self, train_idx, aux_targets, buckets, bucketed,
+                          weighted, scaler_on):
+        """Statically-known per-step IN-PROGRAM collective payload (per
+        replica): the dispatch site reports these since the host cannot
+        observe in-program collectives. Replicated state residency adds
+        its host-side scatter/gather resharding on top (in ``_run``)."""
+        if self.mesh is None:
+            return (0, 0, 0)
+        import numpy as onp
+
+        def nbytes(shape, dtype):
+            n = 1
+            for d in shape:
+                n *= int(d)
+            return n * onp.dtype(str(dtype)).itemsize
+
+        aux_b = sum(nbytes(t.shape, t.dtype) for t in aux_targets)
+        psum = 4 + aux_b  # loss scalar + BN stat means
+        if weighted:
+            psum += 4  # example-weight sum
+        if not bucketed:
+            # non-elementwise fused optimizer: per-tensor grad psum
+            grad_b = sum(nbytes(self.trainer._params[i].data().shape,
+                                self.trainer._params[i].data().dtype)
+                         for i in train_idx)
+            return (0, 0, psum + grad_b)
+        rs = ag = 0
+        for dt, _, bs in buckets:
+            b = bs.padded * onp.dtype(dt).itemsize
+            rs += b
+            ag += b
+        psum += 4  # the AND-reduced finiteness verdict
+        return (rs, ag, psum)
+
+    def _scatter_replicated_state(self):
+        """Flatten per-param optimizer state into dp-sharded bucket arrays
+        (replicated residency, ``shard_update=False``). The program only
+        ever sees sharded state; between steps the per-param arrays in
+        ``trainer._states`` remain the source of truth, so inspection and
+        checkpoints keep the classic layout at the cost of one state
+        reshard each way per step."""
+        import jax
+        from .parallel.mesh import shard_1d
+
+        tr = self.trainer
+        idxs = self._train_idx
+        sharding = shard_1d(self.mesh)
+        return tuple(
+            tuple(jax.device_put(
+                bs.flatten([tr._states[idxs[k]][key]._data for k in ks]),
+                sharding) for key in self._state_keys)
+            for _, ks, bs in self._buckets)
 
     # -- the compiled step --------------------------------------------------
     def _run(self, prog, x, y):
@@ -311,7 +813,15 @@ class CompiledTrainStep:
         keys = self._state_keys
         scaler = self.loss_scaler
         ws = [tr._params[i].data()._data for i in idxs]
-        ss = [tuple(tr._states[i][k]._data for k in keys) for i in idxs]
+        if prog.sharded and self.shard_update:
+            ss = tuple(self._shard_state.state)
+        elif prog.sharded:
+            # replicated residency: scatter per-param state into the same
+            # dp-sharded bucket arrays the sharded mode feeds — the ONE
+            # program both modes dispatch (the parity contract)
+            ss = self._scatter_replicated_state()
+        else:
+            ss = [tuple(tr._states[i][k]._data for k in keys) for i in idxs]
         fs = [p.data()._data for _, p in self._frozen]
         if prog.uses_rng:
             from . import random as _rnd
@@ -335,6 +845,13 @@ class CompiledTrainStep:
             # ONE compiled-program call per step; this bypasses the
             # invoke() chokepoint, so count the dispatch here
             _telemetry.record_dispatch()
+            rs_b, ag_b, ps_b = prog.coll_bytes
+            if prog.sharded and not self.shard_update:
+                # replicated residency: the host-side state reshard is
+                # scatter + gather traffic on top of the program's own
+                rs_b += self._state_bucket_bytes
+                ag_b += self._state_bucket_bytes
+            _telemetry.record_collective(rs_b, ag_b, ps_b)
             with _telemetry.program_timer("train_step"):
                 out = prog.fn(ws, ss, fs, x._data, y._data, key, lrs, wds,
                               ts, rescale, loss_scale)
@@ -344,8 +861,20 @@ class CompiledTrainStep:
         loss_v, aux, new_ws, new_ss, overflow = out
         for k, i in enumerate(idxs):
             tr._params[i].data()._set_data(new_ws[k])
-            for sk, arr in zip(keys, new_ss[k]):
-                tr._states[i][sk]._set_data(arr)
+        if prog.sharded and self.shard_update:
+            self._shard_state.rebind(new_ss)
+        elif prog.sharded:
+            # gather updated shard buckets back into the per-param arrays
+            for (_, ks, bs), st in zip(self._buckets, new_ss):
+                for key, flat in zip(keys, st):
+                    for k, off, n, shape in zip(ks, bs.offsets, bs.sizes,
+                                                bs.shapes):
+                        tr._states[idxs[k]][key]._set_data(
+                            flat[off:off + n].reshape(shape))
+        else:
+            for k, i in enumerate(idxs):
+                for sk, arr in zip(keys, new_ss[k]):
+                    tr._states[i][sk]._set_data(arr)
         # aux write-backs happen regardless of overflow: BN stats update
         # during the forward, before the eager loop could inspect grads
         for target, arr in zip(prog.aux_targets, aux):
@@ -367,11 +896,12 @@ class CompiledTrainStep:
     def _eager_step(self, x, y):
         from . import autograd as ag
 
-        if not self._warned:
-            warnings.warn(
-                f"compile_step: falling back to the eager path — "
-                f"{self.fallback_reason}", RuntimeWarning, stacklevel=3)
-            self._warned = True
+        # one warning per (reason, net) — NOT per CompiledTrainStep: loops
+        # that rebuild the step (e.g. per epoch) must not re-warn
+        warn_once(("train_step_fallback", self.fallback_reason,
+                   id(self.net)),
+                  f"compile_step: falling back to the eager path — "
+                  f"{self.fallback_reason}", RuntimeWarning, stacklevel=3)
         tr = self.trainer
         scaler = self.loss_scaler
         with ag.record():

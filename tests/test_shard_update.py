@@ -1,0 +1,380 @@
+"""ZeRO-1 sharded weight update (ISSUE 5): cross-replica sharded optimizer
+state inside the compiled train step.
+
+Covers: bitwise parity between ``shard_update`` on/off (both settings
+dispatch the SAME compiled ZeRO-1 program and differ only in state
+residency, so trajectories are identical by construction) for SGD+momentum
+and Adam over 10 steps on the 8-way host mesh, with one dispatch per step
+and zero recompiles under an LR schedule; non-divisible bucket sizes
+(padding); loss-scaler skip-on-overflow on shards; checkpoint round-trips
+across shard modes in both directions; per-replica optimizer-state bytes
+(telemetry gauges); collective-bytes accounting; the ``MXTPU_SHARD_UPDATE``
+override; the warn-once fallback for non-elementwise optimizers; and a
+4-way small-mesh smoke.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon, telemetry as tm
+from mxnet_tpu.amp import DynamicLossScaler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    tm.disable()
+    tm.reset()
+    tm.configure(watchdog_warmup_steps=1)
+    yield
+    tm.disable()
+    tm.reset()
+    tm.configure(watchdog_warmup_steps=1)
+
+
+def _make_net(seed=0, bn=False, hidden=16, classes=4):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(hidden, activation="relu"))
+    if bn:
+        net.add(nn.BatchNorm())
+    net.add(nn.Dense(classes))
+    net.initialize()
+    return net
+
+
+def _batch(b=16, d=8, classes=4, seed=0):
+    rs = onp.random.RandomState(seed)
+    x = mx.nd.array(rs.standard_normal((b, d)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, classes, (b,)).astype("float32"))
+    return x, y
+
+
+def _bits_equal(a, b):
+    return (onp.asarray(a, onp.float32).view(onp.uint32)
+            == onp.asarray(b, onp.float32).view(onp.uint32)).all()
+
+
+def _assert_params_bitwise(net_a, net_b):
+    for (name, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                   net_b.collect_params().items()):
+        a, b = pa.data().asnumpy(), pb.data().asnumpy()
+        assert _bits_equal(a, b), \
+            f"{name}: maxdiff={onp.abs(a - b).max():.3e}"
+
+
+# -- bit parity --------------------------------------------------------------
+@pytest.mark.parametrize("opt_name,opt_kwargs,bn", [
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}, True),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-3}, False),
+])
+def test_bit_parity_sharded_vs_replicated_10_steps(opt_name, opt_kwargs, bn):
+    """Acceptance: 10 steps on the 8-way mesh under an LR schedule produce
+    bitwise-identical weights (and BN running stats) for shard_update
+    on/off, with one dispatch per step and zero recompiles."""
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    batches = [_batch(seed=s) for s in range(10)]
+
+    def run(shard):
+        net = _make_net(seed=1, bn=bn)
+        kw = dict(opt_kwargs)
+        kw["lr_scheduler"] = FactorScheduler(step=3, factor=0.5)
+        tr = gluon.Trainer(net.collect_params(), opt_name, kw)
+        step = tr.compile_step(net, loss_fn, mesh=make_mesh({"dp": 8}),
+                               shard_update=shard)
+        assert step.fallback_reason is None
+        assert step.shard_update is shard
+        for x, y in batches[:1]:
+            step(x, y)  # warmup: trace + compile
+        tm.enable()
+        tm.step_report(reset=True)
+        for x, y in batches[1:]:
+            step(x, y)
+        rows = tm.step_report(reset=True)
+        tm.disable()
+        assert len(rows) == 9
+        for row in rows:
+            assert row["dispatches"] == 1, row
+            assert row["recompiles"] == 0, row
+        assert step._traces == 1  # LR schedule decayed: still one program
+        return net, tr
+
+    net_s, tr_s = run(True)
+    net_r, tr_r = run(False)
+    _assert_params_bitwise(net_s, net_r)
+    # optimizer state matches bitwise too (gathered from the shard buckets)
+    gathered = tr_s._shard_state.gather_states()
+    for i, st in enumerate(gathered):
+        if st is None:
+            continue
+        for k, v in st.items():
+            assert _bits_equal(v.asnumpy(), tr_r._states[i][k].asnumpy()), \
+                f"state {i}.{k}"
+
+
+def test_shard_update_auto_on_and_state_bytes():
+    """Auto mode turns sharding on for an elementwise optimizer on a dp>=2
+    mesh; telemetry gauges show per-replica optimizer state at ~1/8 of the
+    replicated bytes (exactly padded/8 per state key)."""
+    net = _make_net(seed=2)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}))  # shard_update=None
+    assert step.shard_update is True
+    x, y = _batch()
+    step(x, y)
+    per_replica = tm.gauge("train_step.opt_state_bytes_per_replica").value
+    replicated = tm.gauge("train_step.opt_state_bytes_replicated").value
+    assert per_replica > 0 and replicated > 0
+    # acceptance: per-replica <= replicated/DP + padding slack
+    n_state = len(step._state_keys)
+    pad_bytes = sum(bs.pad * 4 for _, _, bs in step._buckets) * n_state
+    assert per_replica <= replicated / 8 + pad_bytes
+    expect = sum(bs.shard * 4 for _, _, bs in step._buckets) * n_state
+    assert per_replica == expect
+
+
+def test_non_divisible_bucket_sizes():
+    """Bucket totals not divisible by the dp extent exercise the pad tail
+    (sizes 5*8+5=45 and 3*5+3=18 pad to 48 and 24 over 8 shards)."""
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    batches = [_batch(classes=3, seed=s) for s in range(5)]
+
+    def run(shard):
+        net = _make_net(seed=3, hidden=5, classes=3)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        step = tr.compile_step(net, loss_fn, mesh=make_mesh({"dp": 8}),
+                               shard_update=shard)
+        assert step.fallback_reason is None
+        losses = [float(step(x, y).asnumpy()) for x, y in batches]
+        return net, losses
+
+    net_s, losses_s = run(True)
+    net_r, losses_r = run(False)
+    assert losses_s == losses_r
+    assert all(onp.isfinite(v) for v in losses_s)
+    _assert_params_bitwise(net_s, net_r)
+
+
+def test_overflow_skip_on_shards():
+    """DynamicLossScaler with sharded state: an overflow step leaves the
+    weights AND the shard-resident optimizer state untouched, halves the
+    scale, and does not advance the schedule."""
+    net = _make_net(seed=4)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    sc = amp.attach_loss_scaler(tr, DynamicLossScaler(init_scale=1024.0))
+    step = tr.compile_step(net, loss_fn, mesh=make_mesh({"dp": 8}),
+                           shard_update=True)
+    assert step.shard_update is True
+    x, y = _batch(seed=20)
+    step(x, y)  # clean step: trains
+    snap_w = {n: p.data().asnumpy().copy()
+              for n, p in net.collect_params().items()}
+    snap_st = [None if st is None else {k: v.asnumpy() for k, v in st.items()}
+               for st in tr._shard_state.gather_states()]
+    x_bad = mx.nd.array(onp.full(tuple(x.shape), onp.inf, onp.float32))
+    step(x_bad, y)
+    for n, p in net.collect_params().items():
+        assert _bits_equal(p.data().asnumpy(), snap_w[n]), \
+            f"{n} moved on overflow"
+    for st0, st1 in zip(snap_st, tr._shard_state.gather_states()):
+        if st0 is None:
+            continue
+        for k in st0:
+            assert _bits_equal(st0[k], st1[k].asnumpy()), f"state {k} moved"
+    assert sc.loss_scale == 512.0
+    assert tr.optimizer.num_update == 1
+    step(x, y)  # recovery: the next clean step trains again
+    assert tr.optimizer.num_update == 2
+    assert any(not onp.array_equal(p.data().asnumpy(), snap_w[n])
+               for n, p in net.collect_params().items())
+
+
+# -- checkpointing -----------------------------------------------------------
+@pytest.mark.parametrize("first,second", [(True, False), (False, True)])
+def test_checkpoint_roundtrip_across_shard_modes(tmp_path, first, second):
+    """Train 3 steps in one shard mode, save, resume 2 steps in the other
+    mode — identical (bitwise) to 5 uninterrupted steps: the checkpoint
+    file keeps the per-param layout either way."""
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    batches = [_batch(seed=s) for s in range(5)]
+    fname = str(tmp_path / "trainer.states")
+
+    def make(shard):
+        net = _make_net(seed=5)
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-3})
+        step = tr.compile_step(net, loss_fn, mesh=make_mesh({"dp": 8}),
+                               shard_update=shard)
+        return net, tr, step
+
+    # reference: 5 uninterrupted steps
+    net_ref, _, step_ref = make(first)
+    for x, y in batches:
+        step_ref(x, y)
+
+    # checkpointed: 3 steps, save, reload into the OTHER mode, 2 steps
+    net_a, tr_a, step_a = make(first)
+    for x, y in batches[:3]:
+        step_a(x, y)
+    tr_a.save_states(fname)
+    w_snap = {n: p.data().asnumpy() for n, p in
+              net_a.collect_params().items()}
+
+    net_b, tr_b, step_b = make(second)
+    net_b(batches[0][0])  # settle shapes before set_data
+    for n, p in net_b.collect_params().items():
+        p.set_data(mx.nd.array(w_snap[n]))
+    tr_b.load_states(fname)
+    for x, y in batches[3:]:
+        step_b(x, y)
+    assert tr_b.optimizer.num_update == 5
+    _assert_params_bitwise(net_ref, net_b)
+
+
+# -- partial batches ---------------------------------------------------------
+def test_partial_batch_pads_by_default():
+    """A batch not divisible by the dp extent trains via in-program
+    zero-weight padding (no raise); sharded and replicated agree bitwise on
+    the padded program too."""
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    batches = [_batch(b=13, seed=s) for s in range(3)]
+
+    def run(shard):
+        net = _make_net(seed=6)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9})
+        step = tr.compile_step(net, loss_fn, mesh=make_mesh({"dp": 8}),
+                               shard_update=shard)
+        losses = [float(step(x, y).asnumpy()) for x, y in batches]
+        return net, losses
+
+    net_s, losses_s = run(True)
+    net_r, losses_r = run(False)
+    assert losses_s == losses_r
+    assert all(onp.isfinite(v) for v in losses_s)
+    _assert_params_bitwise(net_s, net_r)
+
+
+def test_strict_batch_raises_on_ragged():
+    net = _make_net(seed=7)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), strict_batch=True)
+    x, y = _batch(b=13)
+    net(x)
+    with pytest.raises(MXNetError, match="not divisible"):
+        step(x, y)
+
+
+# -- telemetry ---------------------------------------------------------------
+def test_collective_bytes_accounting():
+    """Each sharded step records the reduce_scatter + all_gather payload
+    (padded bucket bytes) and the step report carries collective_bytes."""
+    net = _make_net(seed=8)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), shard_update=True)
+    x, y = _batch()
+    step(x, y)  # warmup
+    bucket_bytes = sum(bs.padded * 4 for _, _, bs in step._buckets)
+    tm.enable()
+    tm.step_report(reset=True)
+    rs0 = tm.counter("collective.reduce_scatter_bytes").value
+    ag0 = tm.counter("collective.all_gather_bytes").value
+    step(x, y)
+    assert tm.counter("collective.reduce_scatter_bytes").value - rs0 \
+        == bucket_bytes
+    assert tm.counter("collective.all_gather_bytes").value - ag0 \
+        == bucket_bytes
+    (row,) = tm.step_report(reset=True)
+    assert row["collective_bytes"] >= 2 * bucket_bytes
+
+
+# -- configuration knobs -----------------------------------------------------
+def test_env_override_forces_off(monkeypatch):
+    monkeypatch.setenv("MXTPU_SHARD_UPDATE", "0")
+    net = _make_net(seed=9)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), shard_update=True)
+    assert step.shard_update is False
+    x, y = _batch()
+    assert onp.isfinite(float(step(x, y).asnumpy()))
+
+
+def test_fallback_non_elementwise_warns_once():
+    """LAMB's trust ratio needs whole tensors: a shard request keeps the
+    replicated per-tensor update, warning ONCE per (reason, net) — repeat
+    compile_step calls on the same net stay silent, a new net warns again."""
+    import warnings
+
+    net = _make_net(seed=10)
+    tr = gluon.Trainer(net.collect_params(), "lamb", {"learning_rate": 1e-3})
+    with pytest.warns(RuntimeWarning, match="not\\s+elementwise"):
+        step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               mesh=make_mesh({"dp": 8}), shard_update=True)
+    assert step.shard_update is False
+    assert "elementwise" in step.shard_fallback_reason
+    x, y = _batch()
+    assert onp.isfinite(float(step(x, y).asnumpy()))  # per-tensor psum path
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                        mesh=make_mesh({"dp": 8}), shard_update=True)
+    assert not [w for w in rec if issubclass(w.category, RuntimeWarning)]
+    net2 = _make_net(seed=11)
+    tr2 = gluon.Trainer(net2.collect_params(), "lamb",
+                        {"learning_rate": 1e-3})
+    with pytest.warns(RuntimeWarning, match="not\\s+elementwise"):
+        tr2.compile_step(net2, gluon.loss.SoftmaxCrossEntropyLoss(),
+                         mesh=make_mesh({"dp": 8}), shard_update=True)
+
+
+# -- bench wiring ------------------------------------------------------------
+def test_bench_train_step_sharded_small(monkeypatch):
+    """bench.py train_step --shard-update (small model): one dispatch per
+    step, no recompiles, per-replica optimizer state well under the
+    replicated bytes, and collective traffic recorded."""
+    import bench
+
+    monkeypatch.setenv("BENCH_TRAIN_STEP_SMALL", "1")
+    r = bench.bench_train_step_sharded()
+    assert r["dispatches_per_step"] == 1, r
+    assert r["recompiles_after_warmup"] == 0, r
+    assert r["compiled_programs"] == 1, r
+    assert r["dp_size"] == 8, r
+    assert 0 < r["opt_state_bytes_per_replica"] \
+        < r["opt_state_bytes_replicated"], r
+    assert r["collective_bytes_per_step"] > 0, r
+    assert r["value"] > 0 and r["vs_baseline"] > 0, r
+
+
+# -- small mesh smoke --------------------------------------------------------
+def test_small_mesh_smoke():
+    """4-way dp mesh (half the host devices): sharding on, trains with one
+    dispatch per step."""
+    import jax
+
+    net = _make_net(seed=12)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 4},
+                                          devices=jax.devices()[:4]))
+    assert step.shard_update is True
+    x, y = _batch()
+    step(x, y)
+    tm.enable()
+    tm.step_report(reset=True)
+    losses = [float(step(*_batch(seed=s)).asnumpy()) for s in (1, 2, 3)]
+    assert all(onp.isfinite(v) for v in losses)
+    for row in tm.step_report(reset=True):
+        assert row["dispatches"] == 1 and row["recompiles"] == 0, row

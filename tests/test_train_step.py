@@ -294,6 +294,8 @@ def test_mesh_data_parallel_matches_single_device():
 
 
 def test_mesh_batch_divisibility_checked():
+    """Ragged batches pad in-program by default; ``strict_batch=True``
+    restores the hard error."""
     from mxnet_tpu.base import MXNetError
     from mxnet_tpu.parallel.mesh import make_mesh
 
@@ -302,10 +304,18 @@ def test_mesh_batch_divisibility_checked():
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": 0.1})
     step = trainer.compile_step(net, loss_fn, mesh=make_mesh())
-    x, y = _batch(b=13)  # 13 rows over 8 shards
+    x, y = _batch(b=13)  # 13 rows over 8 shards: pads to 16
     net(x)
+    assert onp.isfinite(float(step(x, y).asnumpy()))
+
+    net2 = _make_net(seed=12, bn=False)
+    trainer2 = gluon.Trainer(net2.collect_params(), "sgd",
+                             {"learning_rate": 0.1})
+    strict = trainer2.compile_step(net2, loss_fn, mesh=make_mesh(),
+                                   strict_batch=True)
+    net2(x)
     with pytest.raises(MXNetError, match="not divisible"):
-        step(x, y)
+        strict(x, y)
 
 
 # -- bench wiring -----------------------------------------------------------
