@@ -4,7 +4,8 @@ Default metric mirrors the reference's headline benchmark
 (example/image-classification/benchmark_score.py; docs/.../faq/perf.md —
 V100 fp16 ResNet-50 batch 128: 2355.04 img/s, BASELINE.md). Select with
 argv[1] or BENCH env: resnet (default) | resnet_train | train_step |
-train_step_sharded (or ``train_step --shard-update``) | lstm_lm |
+train_step_sharded (or ``train_step --shard-update``) |
+train_step_fsdp (or ``train_step --shard-params``) | lstm_lm |
 bert_pretrain | bert_large_pretrain | optimizer_step |
 telemetry_overhead | serve.
 
@@ -309,6 +310,107 @@ def bench_train_step_sharded():
             "dispatches_per_step": disp,
             "recompiles_after_warmup": recomp,
             "compiled_programs": step_s._traces,
+            "mfu": None}
+
+
+def bench_train_step_fsdp():
+    """Full-parameter sharding (``compile_step(..., shard_params=True)``)
+    against ZeRO-1 and the fully replicated update on the same dp mesh,
+    Adam on an MLP. FSDP moves param + grad + optimizer-state residency to
+    1/N per replica at the cost of per-layer just-in-time all_gathers, so
+    steps/s trails the replicated program on a host mesh where collectives
+    are memcpys and memory is no object — the win column is the residency
+    bytes. Reports FSDP steps/s, the FSDP/replicated ratio, ZeRO-1 and
+    replicated steps/s, per-replica vs replicated param/grad/state bytes
+    (from the telemetry gauges sampled at build), and per-step collective
+    bytes. Select with ``bench.py train_step --shard-params``.
+    BENCH_TRAIN_STEP_SMALL=1 shrinks the model/iterations for the
+    not-slow suite."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    small = os.environ.get("BENCH_TRAIN_STEP_SMALL", "") == "1"
+    B, H, WARMUP, ITERS = (32, 64, 2, 10) if small else (256, 1024, 3, 30)
+    mesh = make_mesh()  # every local device on the dp axis
+    n_dp = mesh.shape["dp"]
+    if n_dp < 2:
+        raise RuntimeError(f"param sharding needs dp >= 2, have {n_dp}")
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.standard_normal((B, H)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 10, (B,)).astype("float32"))
+
+    def run(mode):
+        mx.random.seed(7)
+        net = nn.Sequential()
+        net.add(nn.Dense(H, activation="relu"),
+                nn.Dense(H, activation="relu"), nn.Dense(10))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-3})
+        step = tr.compile_step(net, loss_fn, mesh=mesh,
+                               shard_params=(mode == "fsdp"),
+                               shard_update=(mode == "zero1"))
+        if step.fallback_reason is not None:
+            raise RuntimeError("compile_step fell back: "
+                               + step.fallback_reason)
+        for _ in range(WARMUP):
+            _sync(step(x, y)._data)
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = step(x, y)
+        _sync(loss._data)
+        sps = ITERS / (time.perf_counter() - t0)
+        # the residency gauges are sampled once per build; read them before
+        # the next mode's build overwrites them
+        g = {k: telemetry.gauge(f"train_step.{k}").value
+             for k in ("param_bytes_per_replica", "param_bytes_replicated",
+                       "grad_bytes_per_replica",
+                       "opt_state_bytes_per_replica",
+                       "opt_state_bytes_replicated")}
+        return step, sps, g
+
+    _, replicated_sps, _ = run("replicated")
+    _, zero1_sps, zero1_g = run("zero1")
+    step_f, fsdp_sps, fsdp_g = run("fsdp")
+    assert step_f.shard_params is True
+
+    # accounting pass AFTER the timed loops: telemetry on, a few FSDP
+    # steps, read per-step dispatch and collective traffic
+    was_on = telemetry.is_enabled()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        for _ in range(3):
+            _sync(step_f(x, y)._data)
+        rows = telemetry.step_report()
+    finally:
+        telemetry.enable() if was_on else telemetry.disable()
+    disp = max(r["dispatches"] for r in rows) if rows else -1
+    recomp = sum(r["recompiles"] for r in rows) if rows else -1
+    coll = max(r["collective_bytes"] for r in rows) if rows else -1
+    return {"metric": "train_step_fsdp_mlp",
+            "value": round(fsdp_sps, 2), "unit": "steps/s",
+            "vs_baseline": round(fsdp_sps / max(replicated_sps, 1e-9), 3),
+            "replicated_steps_per_sec": round(replicated_sps, 2),
+            "zero1_steps_per_sec": round(zero1_sps, 2),
+            "dp_size": n_dp,
+            "param_bytes_per_replica": int(fsdp_g["param_bytes_per_replica"]),
+            "param_bytes_replicated": int(fsdp_g["param_bytes_replicated"]),
+            "grad_bytes_per_replica": int(fsdp_g["grad_bytes_per_replica"]),
+            "opt_state_bytes_per_replica":
+                int(fsdp_g["opt_state_bytes_per_replica"]),
+            "opt_state_bytes_replicated":
+                int(fsdp_g["opt_state_bytes_replicated"]),
+            "zero1_opt_state_bytes_per_replica":
+                int(zero1_g["opt_state_bytes_per_replica"]),
+            "collective_bytes_per_step": int(coll),
+            "dispatches_per_step": disp,
+            "recompiles_after_warmup": recomp,
+            "compiled_programs": step_f._traces,
             "mfu": None}
 
 
@@ -711,6 +813,8 @@ def main():
              os.environ.get("BENCH", "resnet"))
     if which == "train_step" and "--shard-update" in sys.argv[2:]:
         which = "train_step_sharded"
+    if which == "train_step" and "--shard-params" in sys.argv[2:]:
+        which = "train_step_fsdp"
     import functools
 
     result = {"metric": which, "value": 0.0, "unit": "",
@@ -720,6 +824,7 @@ def main():
               "resnet_train": bench_resnet_train,
               "train_step": bench_train_step,
               "train_step_sharded": bench_train_step_sharded,
+              "train_step_fsdp": bench_train_step_fsdp,
               "lstm_lm": bench_lstm_lm,
               "bert_pretrain": bench_bert_pretrain,
               "bert_large_pretrain": functools.partial(bench_bert_pretrain,

@@ -118,7 +118,9 @@ class Block:
         params = self.collect_params()
         arrays = {}
         for name, p in params.items():
-            if p._data is not None:
+            # FSDP-adopted parameters have _data released but materialize
+            # their full value through data() — include them
+            if p._data is not None or p._provider is not None:
                 d = p.data().asnumpy() if str(p.dtype) != "bfloat16" else \
                     p.data().astype("float32").asnumpy()
                 arrays[name] = d

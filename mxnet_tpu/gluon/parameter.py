@@ -43,6 +43,10 @@ class Parameter:
         self._data = None
         self._deferred_init = None  # (init, ctx) pending shape
         self._trainer = None
+        # FSDP residency: (manager, position) once the compiled train step
+        # adopts this parameter into dp-sharded flat buckets. ``_data`` is
+        # then None between steps; data()/set_data route through the manager
+        self._provider = None
 
     # -- identity -----------------------------------------------------------
     @property
@@ -132,6 +136,12 @@ class Parameter:
     # -- access -------------------------------------------------------------
     def data(self, ctx=None):
         if self._data is None:
+            if self._provider is not None:
+                # FSDP-adopted: materialize the full value from the owning
+                # shard bucket (host gather — checkpoint/inspection path,
+                # never the training hot path)
+                mgr, pos = self._provider
+                return mgr.param_ndarray(pos)
             if self._deferred_init is not None:
                 raise DeferredInitializationError(
                     f"parameter {self.name} awaits shape inference; run a "
@@ -145,6 +155,12 @@ class Parameter:
         return [self.data()]
 
     def grad(self, ctx=None):
+        if self._data is None and self._provider is not None:
+            raise MXNetError(
+                f"parameter {self.name} is adopted by the FSDP compiled "
+                "step (shard_params=True): gradients exist only inside the "
+                "compiled program, pre-scattered into the owning shard — "
+                "compile without shard_params to inspect per-param grads")
         d = self.data()
         if d._grad is None:
             raise MXNetError(f"parameter {self.name} has grad_req='null'")
@@ -165,6 +181,11 @@ class Parameter:
                 f"shape mismatch for parameter {self.name}: expected "
                 f"{self._shape}, got {tuple(data.shape)}")
         if self._data is None:
+            if self._provider is not None:
+                # FSDP-adopted: write through into the shard bucket
+                mgr, pos = self._provider
+                mgr.param_write(pos, data)
+                return
             import jax.numpy as jnp
 
             self._shape = tuple(data.shape)

@@ -79,7 +79,8 @@ class Trainer:
 
     # -- whole-step compilation ---------------------------------------------
     def compile_step(self, net, loss_fn, mesh=None, loss_scaler=None,
-                     shard_update=None, strict_batch=False):
+                     shard_update=None, strict_batch=False,
+                     shard_params=None, partition_rules=None):
         """Compile forward + loss + backward (+ mesh allreduce) + update into
         ONE donated-buffer program; returns the CompiledTrainStep, also
         exposed as ``self.step_fn``. Semantics of the compiled callable match
@@ -94,12 +95,26 @@ class Trainer:
         'dp' axis of size >= 2 and the optimizer's recurrence is
         elementwise; ``MXTPU_SHARD_UPDATE=0/1`` overrides. ``strict_batch``
         restores the hard error for batches not divisible by the dp extent
-        instead of in-program zero-weight padding."""
+        instead of in-program zero-weight padding.
+
+        ``shard_params`` selects full-parameter sharding (ZeRO-3 / FSDP):
+        weights AND optimizer state live as per-layer flat buckets sharded
+        1/N over 'dp' between steps; the program all-gathers each layer
+        just-in-time and gradients reduce-scatter straight into the owning
+        shard — no full-sized buffer ever persists. ``None`` = auto: on
+        when additionally the trainables total >=
+        ``MXTPU_SHARD_PARAMS_AUTO_MB`` MiB (default 256);
+        ``MXTPU_SHARD_PARAMS=0/1`` overrides. ``partition_rules`` — ordered
+        ``(regex, PartitionSpec)`` pairs over parameter names (default
+        ``parallel.partition.fsdp_rules()``) — decide which trainables
+        shard; scalar leaves always replicate. FSDP supersedes
+        ``shard_update``. See docs/DESIGN.md "Full-parameter sharding"."""
         from ..train_step import CompiledTrainStep
 
         self._compiled_step = CompiledTrainStep(
             self, net, loss_fn, mesh=mesh, loss_scaler=loss_scaler,
-            shard_update=shard_update, strict_batch=strict_batch)
+            shard_update=shard_update, strict_batch=strict_batch,
+            shard_params=shard_params, partition_rules=partition_rules)
         return self._compiled_step
 
     @property
@@ -269,6 +284,15 @@ class Trainer:
                              for sk in state_keys)]
             small = small if len(small) > 1 else []
             small_set = frozenset(small)
+            if small:
+                # the flatten/pad layout arithmetic lives in ONE place
+                # (parallel.collectives.BucketSpec) shared with the ZeRO-1
+                # and FSDP bucket schedules; n_shards=1 = no padding
+                from ..parallel.collectives import BucketSpec
+
+                small_bs = BucketSpec(
+                    [tuple(self._params[idxs[k]].data().shape)
+                     for k in small], 1)
 
             def multi_step(ws, ss, gs, lrs, wds, ts, rs):
                 # body executes at TRACE time only — the counter observes
@@ -302,33 +326,23 @@ class Trainer:
                     # repeated per element (same arithmetic per element ->
                     # bit-identical to the per-tensor calls)
                     ksel = jnp.asarray(small)
-                    szs = jnp.asarray([sizes[k] for k in small])
-                    tot = sum(sizes[k] for k in small)
 
                     def flat(xs):
-                        return jnp.concatenate(
-                            [xs[k].reshape(-1) for k in small])
-
-                    def spread(v):
-                        return jnp.repeat(v[ksel], szs,
-                                          total_repeat_length=tot)
+                        return small_bs.flatten([xs[k] for k in small])
 
                     args = [flat(ws),
-                            *(jnp.concatenate(
-                                [ss[k][j].reshape(-1) for k in small])
+                            *(small_bs.flatten([ss[k][j] for k in small])
                               for j in range(n_state)),
-                            flat(gs) * rs, spread(lrs), spread(wds)]
+                            flat(gs) * rs, small_bs.spread(lrs[ksel]),
+                            small_bs.spread(wds[ksel])]
                     if needs_t:
-                        args.append(spread(ts))
+                        args.append(small_bs.spread(ts[ksel]))
                     out = raw(*args)
                     out = out if n_state else (out,)
-                    off = 0
-                    for k in small:
-                        sl = slice(off, off + sizes[k])
-                        new_ws[k] = out[0][sl].reshape(ws[k].shape)
-                        new_ss[k] = tuple(o[sl].reshape(ws[k].shape)
-                                          for o in out[1:])
-                        off += sizes[k]
+                    parts = [small_bs.unflatten(o) for o in out]
+                    for si, k in enumerate(small):
+                        new_ws[k] = parts[0][si]
+                        new_ss[k] = tuple(p[si] for p in parts[1:])
                 return new_ws, new_ss
 
             fused = jax.jit(multi_step, donate_argnums=(0, 1))

@@ -216,8 +216,26 @@ class Optimizer:
         ZeRO-1 sharded weight update concatenates parameters into flat
         per-dtype buckets and updates only each replica's contiguous slice;
         per-tensor reductions (LAMB/LARS trust ratios, GroupAdaGrad row
-        sums) would need the whole tensor and keep the replicated path."""
-        return self._fusable is not None and self._fusable[3]
+        sums) would need the whole tensor and keep the replicated path.
+        Full-parameter sharding (FSDP) runs the same recurrence on
+        per-layer shards and has the identical requirement."""
+        return self.sharding_eligibility()[0]
+
+    def sharding_eligibility(self):
+        """``(ok, reason)`` for the flat-bucket sharded schedules (ZeRO-1
+        and FSDP both update arbitrary contiguous chunk slices, so both
+        need a fusable, elementwise recurrence). ``reason`` is the
+        user-facing sentence the train step's warn-once fallbacks emit —
+        declared here, next to the capability, so the two resolvers never
+        drift apart."""
+        if self._fusable is None:
+            return False, (f"{type(self).__name__} declares no fusable "
+                           "per-tensor step")
+        if not self._fusable[3]:
+            return False, (f"{type(self).__name__}'s recurrence is not "
+                           "elementwise (per-tensor reductions need the "
+                           "full tensor)")
+        return True, None
 
     def _apply(self, weight, grad, state, lr, wd, t):
         spec = self._step_spec

@@ -96,6 +96,19 @@ class BucketSpec:
         return [flat[o:o + n].reshape(s)
                 for o, n, s in zip(self.offsets, self.sizes, self.shapes)]
 
+    def flatten_host(self, xs, dtype="float32", pad_value=0):
+        """Host-side (numpy) counterpart of ``flatten``: concatenate
+        ``xs`` into one padded flat vector WITHOUT touching the device.
+        The one code path every residency manager uses to build bucket
+        images (ZeRO-1 state scatter, FSDP param/state adoption) — the
+        layout arithmetic lives here, not at each call site."""
+        import numpy as onp
+
+        flat = onp.full((self.padded,), pad_value, dtype=onp.dtype(dtype))
+        for x, off, n in zip(xs, self.offsets, self.sizes):
+            flat[off:off + n] = onp.asarray(x).reshape(-1)
+        return flat
+
     def spread(self, per_tensor, pad_value=0.0):
         """Per-tensor scalars -> per-element flat vector (padded). Static
         repeat lengths, so this never retraces on value changes."""

@@ -16,7 +16,8 @@ from ..base import MXNetError
 
 __all__ = ["AxisNames", "make_mesh", "default_mesh", "replicated",
            "shard_batch", "shard_params", "shard_map_compat", "P",
-           "shard_1d", "zeros_sharded", "axis_extent"]
+           "shard_1d", "zeros_sharded", "axis_extent",
+           "bytes_per_replica"]
 
 
 class AxisNames:
@@ -115,6 +116,17 @@ def zeros_sharded(mesh: Mesh, shape, dtype, spec) -> jax.Array:
 
     fn = jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)
     return fn()
+
+
+def bytes_per_replica(arr) -> int:
+    """Bytes of ``arr`` ONE replica actually holds: the first addressable
+    shard's buffer size (uniform shards — every 1/N residency claim in the
+    sharded train step is this number), or the whole buffer for an
+    unsharded array."""
+    shards = getattr(arr, "addressable_shards", None)
+    if shards:
+        return shards[0].data.nbytes
+    return arr.nbytes
 
 
 def shard_params(mesh: Mesh, spec_fn=None):

@@ -1,0 +1,168 @@
+"""Regex partition rules over named parameter trees.
+
+The production idiom for declaring sharding layouts (EasyLM / fmengine
+lineage, SNIPPETS [3]): an ORDERED list of ``(regex, PartitionSpec)`` rules
+is matched against the slash-joined path of every leaf in a named parameter
+tree. First matching rule wins; scalar (and size-1) leaves are never
+partitioned; a leaf no rule covers is an explicit error naming the offending
+path — silent replication of a 30k x 4k embedding is exactly the bug this
+API exists to prevent.
+
+Two consumers share the vocabulary:
+
+- ``parallel.five_axis`` layouts (tp/pp/ep specs over stage-stacked trees)
+  can be written as rules and expanded with ``match_partition_rules`` —
+  rules mixing 'dp' with 'tp'/'pp' compose on one mesh because a
+  PartitionSpec is just named mesh axes.
+- ``Trainer.compile_step(shard_params=True)`` (FSDP): the rules decide
+  which trainables live dp-sharded. ``fsdp_groups`` then folds the sharded
+  leaves into per-layer flat buckets (``collectives.BucketSpec``) — the
+  gather/scatter schedule of the compiled step.
+"""
+from __future__ import annotations
+
+import re
+
+from jax.sharding import PartitionSpec as PS
+
+from ..base import MXNetError
+
+__all__ = ["named_tree_map", "match_partition_rules", "spec_axes",
+           "fsdp_rules", "layer_key", "fsdp_groups"]
+
+
+def named_tree_map(fn, tree, sep="/"):
+    """Map ``fn(path, leaf)`` over a nested dict/list/tuple tree, building
+    the slash-joined path from the keys/indices along the way. Anything
+    that is not a dict/list/tuple is a leaf (jax arrays, NDArrays,
+    Parameters, scalars). Returns a tree of the same structure."""
+    def walk(path, node):
+        if isinstance(node, dict):
+            return {k: walk(f"{path}{sep}{k}" if path else str(k), v)
+                    for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            out = [walk(f"{path}{sep}{i}" if path else str(i), v)
+                   for i, v in enumerate(node)]
+            return tuple(out) if isinstance(node, tuple) else out
+        return fn(path, node)
+    return walk("", tree)
+
+
+def _leaf_shape(path, leaf):
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        if isinstance(leaf, (int, float, complex, bool)):
+            return ()
+        raise MXNetError(
+            f"parameter {path!r} has no known shape (deferred init?); "
+            "initialize the tree before matching partition rules")
+    if any(d is None or d <= 0 for d in shape):
+        # gluon marks not-yet-inferred dims with 0/-1 (parameter._shape_known)
+        raise MXNetError(
+            f"parameter {path!r} has unresolved shape {tuple(shape)}; run a "
+            "settle forward before matching partition rules")
+    return tuple(int(d) for d in shape)
+
+
+def match_partition_rules(rules, tree, sep="/"):
+    """Expand ``rules`` — an ordered iterable of ``(regex, PartitionSpec)``
+    — over ``tree``, returning a same-structure tree of PartitionSpecs.
+
+    Contract (the SNIPPETS [3] semantics, hardened):
+    - scalar and size-1 leaves get ``PS()`` without consulting the rules
+      (partitioning a scalar is never meaningful);
+    - the FIRST rule whose regex ``re.search``-matches the leaf's path
+      wins — order your specific rules before the catch-all;
+    - a leaf no rule matches raises ``MXNetError`` naming the path.
+    """
+    rules = [(r, spec) for r, spec in rules]
+
+    def get(path, leaf):
+        shape = _leaf_shape(path, leaf)
+        size = 1
+        for d in shape:
+            size *= d
+        if not shape or size == 1:
+            return PS()
+        for rule, spec in rules:
+            if re.search(rule, path) is not None:
+                return spec
+        raise MXNetError(
+            f"no partition rule matched parameter {path!r} "
+            f"(shape {shape}); add a rule or a catch-all ('.*', PS(...))")
+
+    return named_tree_map(get, tree, sep=sep)
+
+
+def spec_axes(spec):
+    """The set of mesh axis names a PartitionSpec mentions (entries may be
+    None, a name, or a tuple of names)."""
+    axes = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.update(entry)
+        else:
+            axes.add(entry)
+    return axes
+
+
+def fsdp_rules():
+    """The default full-parameter-sharding rule set: every non-scalar
+    trainable shards over 'dp' (match_partition_rules exempts scalar and
+    size-1 leaves on its own)."""
+    return ((r".*", PS("dp")),)
+
+
+def layer_key(name, sep="."):
+    """The gather/scatter granule a parameter belongs to: its owning
+    layer's name prefix ('encoder.layers.0.attn_qkv.weight' and '....bias'
+    gather together; a bare name is its own layer)."""
+    return name.rsplit(sep, 1)[0] if sep in name else name
+
+
+def fsdp_groups(entries, specs, n_shards, axis="dp", sep="."):
+    """Fold flat named trainables into the per-layer bucket schedule.
+
+    ``entries``: ordered ``(key, name, shape, dtype_str)`` tuples (key is
+    the caller's position index); ``specs``: ``{name: PartitionSpec}`` from
+    ``match_partition_rules``. Leaves whose spec mentions ``axis`` group
+    into one ``BucketSpec`` per (layer, dtype) sharded 1/N over ``axis``;
+    the rest (scalars, size-1, explicitly replicated leaves) pool into
+    per-dtype replicated buckets updated identically on every shard. A
+    spec mentioning any OTHER mesh axis is rejected — tensor-parallel
+    layouts compose at the five_axis/Learner level, not inside the
+    dp-compiled step.
+
+    Returns ``[(layer, dtype, keys, BucketSpec, sharded)]`` in
+    first-appearance order (the schedule order of the compiled program).
+    """
+    from .collectives import BucketSpec
+
+    grouped = {}   # (layer, dtype, sharded) -> [(key, shape)]
+    order = []
+    for key, name, shape, dtype in entries:
+        spec = specs[name]
+        axes = spec_axes(spec)
+        if axes - {axis}:
+            raise MXNetError(
+                f"partition rule for {name!r} names mesh axes "
+                f"{sorted(axes - {axis})}; compile_step shards parameters "
+                f"over '{axis}' only — tensor/pipeline-parallel specs "
+                "belong to parallel.five_axis / parallel.learner")
+        sharded = axis in axes
+        gk = (layer_key(name, sep=sep) if sharded else "_replicated",
+              dtype, sharded)
+        if gk not in grouped:
+            grouped[gk] = []
+            order.append(gk)
+        grouped[gk].append((key, shape))
+    out = []
+    for layer, dtype, sharded in order:
+        items = grouped[(layer, dtype, sharded)]
+        keys = [k for k, _ in items]
+        shapes = [s for _, s in items]
+        bs = BucketSpec(shapes, n_shards if sharded else 1)
+        out.append((layer, dtype, keys, bs, sharded))
+    return out

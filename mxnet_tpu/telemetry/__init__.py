@@ -38,6 +38,7 @@ __all__ = ["enable", "disable", "is_enabled", "configure", "reset",
            "counter", "gauge", "timer", "histogram", "metrics", "event",
            "events", "dump_events", "export_chrome_trace", "mark_step",
            "program_timer", "step_report", "last_step", "watchdog_stats",
+           "record_fsdp",
            "Monitor", "Counter", "Gauge", "Timer", "Histogram", "Registry",
            "format_signature"]
 
@@ -236,6 +237,21 @@ def record_collective(reduce_scatter_bytes=0, all_gather_bytes=0,
         _C_AG_BYTES.inc(all_gather_bytes)
     if psum_bytes:
         _C_PSUM_BYTES.inc(psum_bytes)
+
+
+def record_fsdp(layer_bytes):
+    """Count one dispatch's FSDP per-layer collective schedule.
+
+    ``layer_bytes``: iterable of ``(layer, gather_bytes, scatter_bytes)``
+    rows computed at build time — the just-in-time weight all_gathers and
+    the gradient psum_scatters each layer's bucket performs per step.
+    Schedule-level numbers (XLA may CSE re-gathers); callers guard on
+    ``telemetry.ON``."""
+    for layer, gather_b, scatter_b in layer_bytes:
+        if gather_b:
+            REGISTRY.counter(f"fsdp.gather_bytes.{layer}").inc(gather_b)
+        if scatter_b:
+            REGISTRY.counter(f"fsdp.scatter_bytes.{layer}").inc(scatter_b)
 
 
 def compile_count():

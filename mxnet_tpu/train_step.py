@@ -59,6 +59,39 @@ before fusion) nor ``reduce_precision`` pinning (reassociated across, and
 a no-op in the CPU emitter) recovers parity. Non-elementwise fused
 optimizers (trust-ratio / whole-tensor reductions) keep the per-tensor
 psum update, replicated on every device.
+
+Full-parameter sharding (ZeRO-3 / FSDP)
+---------------------------------------
+ZeRO-1 still keeps a FULL copy of every weight on every replica between
+steps. ``shard_params`` goes the rest of the way: parameters AND optimizer
+state live as per-layer flat buckets sharded 1/N over 'dp' end-to-end.
+Which trainables shard is decided by regex partition rules
+(``parallel.partition.match_partition_rules``; default: everything
+non-scalar over 'dp'); ``parallel.partition.fsdp_groups`` folds them into
+one ``BucketSpec`` per (layer, dtype) — scalars and explicitly-replicated
+leaves pool into small replicated buckets updated identically everywhere.
+
+Inside the single donated program each layer's bucket is ``all_gather``ed
+just-in-time where the forward first needs it; with rematerialization on
+(``MXTPU_FSDP_REMAT``, default ``dots`` = ``jax.checkpoint`` with the
+``dots_saveable`` policy) the backward re-gathers instead of keeping full
+weights live, so peak weight residency tracks the largest layer, not the
+model. The gradient needs NO explicit reduce for sharded buckets: the vjp
+transpose of a tiled ``all_gather`` IS ``psum_scatter``, so gradients
+arrive pre-reduced in the owning shard's layout. The recurrence then runs
+on resident shards and its outputs STAY sharded — there is no trailing
+weight all-gather; the next step's forward gathers again. Per-replica
+param + grad + optimizer-state residency all drop ~Nx (the residency
+gauges ``train_step.param/grad/opt_state_bytes_per_replica`` report it).
+
+Between steps ``Parameter._data`` is released: ``data()`` materializes a
+full value on demand from the bucket (host gather — checkpoints and
+inspection, not the hot path), ``set_data`` writes through into the
+bucket, and checkpoints keep the classic per-param layout in both
+directions. Because the FSDP program is STRUCTURALLY different from the
+replicated/ZeRO-1 one, its trajectory may differ by XLA's input-dependent
+1-ulp rounding (see above) — parity with the other modes is numerical
+(tight tolerance), not bitwise; checkpoint round-trips remain bitwise.
 """
 from __future__ import annotations
 
@@ -74,15 +107,16 @@ class _Program:
     """One compiled step program + the trace metadata needed to drive it."""
 
     __slots__ = ("fn", "uses_rng", "aux_targets", "n_aux", "sharded",
-                 "coll_bytes")
+                 "fsdp", "coll_bytes")
 
-    def __init__(self, fn, uses_rng, aux_targets, sharded=False,
+    def __init__(self, fn, uses_rng, aux_targets, sharded=False, fsdp=False,
                  coll_bytes=(0, 0, 0)):
         self.fn = fn
         self.uses_rng = uses_rng
         self.aux_targets = aux_targets
         self.n_aux = len(aux_targets)
         self.sharded = sharded
+        self.fsdp = fsdp
         # (reduce_scatter, all_gather, psum) bytes per call, known at build
         # time — the host's only window into in-program collective traffic
         self.coll_bytes = coll_bytes
@@ -151,19 +185,15 @@ class _ShardedOptState:
 
     def _scatter_bucket(self, ks, bs):
         import jax
-        import numpy as onp
         from .parallel.mesh import shard_1d
 
         tr = self.trainer
         sharding = shard_1d(self.mesh)
-        out = []
-        for key in self.state_keys:
-            flat = onp.zeros((bs.padded,), onp.float32)
-            for k, off, n in zip(ks, bs.offsets, bs.sizes):
-                st = tr._states[self.train_idx[k]]
-                flat[off:off + n] = st[key].asnumpy().reshape(-1)
-            out.append(jax.device_put(flat, sharding))
-        return tuple(out)
+        return tuple(
+            jax.device_put(bs.flatten_host(
+                [tr._states[self.train_idx[k]][key].asnumpy() for k in ks]),
+                sharding)
+            for key in self.state_keys)
 
     # -- step rebind --------------------------------------------------------
     def rebind(self, new_state):
@@ -218,6 +248,217 @@ class _ShardedOptState:
                    for _, _, bs in self.buckets)
 
 
+class _FSDPState:
+    """FSDP residency: parameters AND optimizer state as per-layer flat
+    buckets sharded 1/N over 'dp', end-to-end.
+
+    Unlike ``_ShardedOptState`` (ZeRO-1: full weights between steps,
+    sharded state only), nothing full-sized persists anywhere. On adoption
+    the per-param ``Parameter._data`` buffers are released and replaced by
+    bucket images (``BucketSpec.flatten_host`` + one ``device_put`` under
+    ``P('dp')`` per sharded group; replicated pools go up whole);
+    ``Parameter.data()`` then materializes a full value on demand from the
+    bucket and ``set_data`` writes through into it — checkpoints and
+    inspection keep working in the classic per-param layout. Re-traces of
+    the step (new batch signature) need the stable NDArray objects the
+    deferred-compute variables bind to, so ``materialize_into_params`` /
+    ``release_params`` bracket each build.
+
+    The checkpoint bridge (``gather_states``/``scatter_from_trainer``) and
+    the residency gauges mirror ``_ShardedOptState`` so
+    ``Trainer.save_states``/``load_states`` and dashboards are mode-
+    agnostic. The single-controller gather caveat applies here too.
+    """
+
+    def __init__(self, mesh, opt, trainer, train_idx, groups, state_keys):
+        self.mesh = mesh
+        self.opt = opt
+        self.trainer = trainer
+        self.train_idx = train_idx
+        self.groups = groups   # [(layer, dtype, ks, BucketSpec, sharded)]
+        self.state_keys = state_keys
+        self.params = []       # per group: flat bucket jax.Array
+        self.state = []        # per group: tuple over state keys
+        self._where = {}       # train position k -> (group idx, slot idx)
+        for gi, (_, _, ks, _, _) in enumerate(groups):
+            for si, k in enumerate(ks):
+                self._where[k] = (gi, si)
+        self._adopt_params()
+        self._init_state()
+        p_shard = self.per_replica_param_bytes()
+        _telemetry.gauge("train_step.param_bytes_per_replica").set(p_shard)
+        _telemetry.gauge("train_step.param_bytes_replicated").set(
+            self.replicated_param_bytes())
+        # gradients exist only transiently in-program, pre-scattered into
+        # the same shard layout — their residency bound IS the shard bytes
+        _telemetry.gauge("train_step.grad_bytes_per_replica").set(p_shard)
+        _telemetry.gauge("train_step.opt_state_bytes_per_replica").set(
+            self.per_replica_state_bytes())
+        _telemetry.gauge("train_step.opt_state_bytes_replicated").set(
+            self.replicated_state_bytes())
+
+    def _sharding(self, sharded):
+        from .parallel.mesh import replicated, shard_1d
+
+        return shard_1d(self.mesh) if sharded else replicated(self.mesh)
+
+    # -- adoption -----------------------------------------------------------
+    def _adopt_params(self):
+        import jax
+
+        tr = self.trainer
+        for _, dt, ks, bs, sh in self.groups:
+            img = bs.flatten_host(
+                [tr._params[self.train_idx[k]].data().asnumpy()
+                 for k in ks], dtype=dt)
+            self.params.append(jax.device_put(img, self._sharding(sh)))
+        # release the full per-param buffers; data()/set_data route here
+        for k, i in enumerate(self.train_idx):
+            p = tr._params[i]
+            p._provider = (self, k)
+            p._data = None
+
+    def _init_state(self):
+        from .parallel.mesh import P, zeros_sharded
+        import jax.numpy as jnp
+
+        tr, keys = self.trainer, self.state_keys
+        for _, _, ks, bs, sh in self.groups:
+            if not keys:
+                self.state.append(())
+                continue
+            idxs = [self.train_idx[k] for k in ks]
+            if all(tr._states[i] is None for i in idxs):
+                spec = P("dp") if sh else P()
+                self.state.append(tuple(
+                    zeros_sharded(self.mesh, (bs.padded,), jnp.float32,
+                                  spec)
+                    for _ in keys))
+            else:
+                for i in idxs:
+                    if tr._states[i] is None:
+                        tr._states[i] = \
+                            self.opt.create_state_multi_precision(
+                                i, tr._params[i].data())
+                self.state.append(self._scatter_group(ks, bs, sh))
+                for i in idxs:
+                    tr._states[i] = None  # the buckets own it now
+
+    def _scatter_group(self, ks, bs, sh):
+        import jax
+
+        tr = self.trainer
+        sharding = self._sharding(sh)
+        return tuple(
+            jax.device_put(bs.flatten_host(
+                [tr._states[self.train_idx[k]][key].asnumpy() for k in ks]),
+                sharding)
+            for key in self.state_keys)
+
+    # -- Parameter provider hooks -------------------------------------------
+    def param_ndarray(self, k):
+        """Materialize one adopted parameter's FULL value (host gather of
+        its group bucket) — the checkpoint/inspection path."""
+        import numpy as onp
+        from .ndarray.ndarray import NDArray
+
+        gi, si = self._where[k]
+        bs = self.groups[gi][3]
+        flat = onp.asarray(self.params[gi])  # gathers every shard to host
+        off, n = bs.offsets[si], bs.sizes[si]
+        return NDArray(flat[off:off + n].reshape(bs.shapes[si]))
+
+    def param_write(self, k, value):
+        """Write-through ``set_data`` for an adopted parameter: rebuild the
+        group's bucket image with the new slice (the load/re-init path)."""
+        import jax
+        import numpy as onp
+
+        gi, si = self._where[k]
+        _, dt, _, bs, sh = self.groups[gi]
+        flat = onp.asarray(self.params[gi]).copy()
+        off, n = bs.offsets[si], bs.sizes[si]
+        flat[off:off + n] = \
+            onp.asarray(value).astype(onp.dtype(dt), copy=False).reshape(-1)
+        self.params[gi] = jax.device_put(flat, self._sharding(sh))
+
+    # -- re-trace bracket ---------------------------------------------------
+    def materialize_into_params(self):
+        """Temporarily restore full per-param ``_data`` (from the buckets)
+        so a re-trace binds its variables to the stable NDArray objects the
+        forward will read; ``release_params`` drops them again."""
+        tr = self.trainer
+        for k, i in enumerate(self.train_idx):
+            if tr._params[i]._data is None:
+                tr._params[i]._data = self.param_ndarray(k)
+
+    def release_params(self):
+        tr = self.trainer
+        for i in self.train_idx:
+            tr._params[i]._data = None
+
+    # -- step rebind --------------------------------------------------------
+    def rebind(self, new_params, new_state):
+        """Adopt the program's donated-output param + state buckets."""
+        self.params = list(new_params)
+        self.state = [tuple(st) for st in new_state]
+
+    # -- checkpoint bridge --------------------------------------------------
+    def gather_states(self):
+        """Per-param full state dicts (the replicated pickle layout)."""
+        import numpy as onp
+        from .ndarray.ndarray import NDArray
+
+        out = [None] * len(self.trainer._params)
+        for (_, _, ks, bs, _), st in zip(self.groups, self.state):
+            for key, arr in zip(self.state_keys, st):
+                flat = onp.asarray(arr)
+                for k, off, n, shape in zip(ks, bs.offsets, bs.sizes,
+                                            bs.shapes):
+                    i = self.train_idx[k]
+                    if out[i] is None:
+                        out[i] = {}
+                    out[i][key] = NDArray(flat[off:off + n].reshape(shape))
+        return out
+
+    def scatter_from_trainer(self):
+        """Re-shard after ``Trainer.load_states`` refilled ``_states``."""
+        tr = self.trainer
+        state = []
+        for _, _, ks, bs, sh in self.groups:
+            idxs = [self.train_idx[k] for k in ks]
+            for i in idxs:
+                if tr._states[i] is None:
+                    tr._states[i] = self.opt.create_state_multi_precision(
+                        i, tr._params[i].data())
+            state.append(self._scatter_group(ks, bs, sh))
+            for i in idxs:
+                tr._states[i] = None
+        self.state = state
+
+    # -- accounting ---------------------------------------------------------
+    def per_replica_param_bytes(self):
+        from .parallel.mesh import bytes_per_replica
+
+        return sum(bytes_per_replica(b) for b in self.params)
+
+    def replicated_param_bytes(self):
+        """What unsharded residency would hold per replica (full weights)."""
+        import numpy as onp
+
+        return sum(bs.total * onp.dtype(dt).itemsize
+                   for _, dt, _, bs, _ in self.groups)
+
+    def per_replica_state_bytes(self):
+        from .parallel.mesh import bytes_per_replica
+
+        return sum(bytes_per_replica(a) for st in self.state for a in st)
+
+    def replicated_state_bytes(self):
+        return sum(bs.total * 4 * len(self.state_keys)
+                   for _, _, _, bs, _ in self.groups)
+
+
 class CompiledTrainStep:
     """Callable ``(x, y) -> loss`` running the whole step as one program.
 
@@ -233,6 +474,19 @@ class CompiledTrainStep:
     see the module docstring. Unsupported configurations keep the replicated
     in-program update with a one-time warning
     (reason in ``.shard_fallback_reason``).
+
+    ``shard_params`` (default: auto-on when additionally the trainables
+    total at least ``MXTPU_SHARD_PARAMS_AUTO_MB`` MiB, 256 by default —
+    decided at first build, when shapes are known; forced by
+    ``MXTPU_SHARD_PARAMS=0/1``) goes full FSDP: parameters AND optimizer
+    state live dp-sharded between steps, gathered just-in-time per layer
+    inside the program — see the module docstring. ``partition_rules``
+    (ordered ``(regex, PartitionSpec)`` pairs, default
+    ``parallel.partition.fsdp_rules()``) decide which trainables shard.
+    FSDP supersedes ``shard_update`` (the weights are already sharded; the
+    ZeRO-1 trailing all-gather would undo the point). Unsupported explicit
+    requests keep the unsharded residency with a one-time warning (reason
+    in ``.shard_params_fallback_reason``).
 
     A batch not divisible by the dp extent is padded IN-PROGRAM with
     zero-example-weight rows (the loss becomes the weighted mean over the
@@ -250,7 +504,8 @@ class CompiledTrainStep:
     """
 
     def __init__(self, trainer, net, loss_fn, mesh=None, loss_scaler=None,
-                 name="train_step", shard_update=None, strict_batch=False):
+                 name="train_step", shard_update=None, strict_batch=False,
+                 shard_params=None, partition_rules=None):
         self.trainer = trainer
         self.net = net
         self.loss_fn = loss_fn
@@ -262,7 +517,14 @@ class CompiledTrainStep:
         self.fallback_reason = None
         self.shard_update = False
         self.shard_fallback_reason = None
+        self.shard_params = False
+        self.shard_params_fallback_reason = None
+        self.partition_rules = partition_rules
+        self._shard_params_auto = False  # size threshold pending 1st build
         self._shard_state = None
+        self._fsdp_state = None
+        self._fsdp_groups = None
+        self._fsdp_layer_bytes = ()      # [(layer, gather_b, scatter_b)]
         self._cache = {}       # input signature -> _Program
         self._train_idx = None
         self._frozen = None
@@ -272,6 +534,7 @@ class CompiledTrainStep:
         self._traces = 0       # trace-time count (observes recompiles)
         self._dispatches = 0   # compiled-program calls
         self._check_supported()
+        self._resolve_shard_params(shard_params)
         self._resolve_shard_update(shard_update)
 
     # -- support matrix -----------------------------------------------------
@@ -313,11 +576,51 @@ class CompiledTrainStep:
 
         return int(self.mesh.shape[AxisNames.DP])
 
+    def _shardable(self):
+        """``(ok, reason)`` for BOTH flat-bucket sharded schedules (ZeRO-1
+        and FSDP): a dp mesh of >= 2 shards and an elementwise fusable
+        recurrence."""
+        if self._dp_size() < 2:
+            return False, "no mesh with a 'dp' axis of size >= 2"
+        return self.trainer._optimizer.sharding_eligibility()
+
+    def _resolve_shard_params(self, requested):
+        """Decide parameter residency. ``MXTPU_SHARD_PARAMS=0/1`` overrides
+        the argument; ``None`` = auto: on when shardable AND the trainables
+        total at least ``MXTPU_SHARD_PARAMS_AUTO_MB`` MiB (256 by default)
+        — that size check runs at first build, once shapes are known. An
+        explicit request the configuration cannot honor keeps the unsharded
+        parameter residency (ZeRO-1/replicated per ``shard_update``) and
+        warns once per (reason, net)."""
+        env = os.environ.get("MXTPU_SHARD_PARAMS")
+        if env is not None:
+            requested = env.strip().lower() not in ("0", "false", "off", "")
+        if requested is False:
+            return
+        if self.fallback_reason is not None:
+            return  # the whole step already falls back to eager
+        ok, reason = self._shardable()
+        if ok:
+            if requested is None:
+                self._shard_params_auto = True
+            else:
+                self.shard_params = True
+            return
+        if requested is None:
+            return  # auto quietly keeps the existing schedule
+        self.shard_params_fallback_reason = reason
+        warn_once(("shard_params", reason, id(self.net)),
+                  f"compile_step: full-parameter sharding unavailable — "
+                  f"{reason}; keeping the unsharded parameter residency",
+                  RuntimeWarning)
+
     def _resolve_shard_update(self, requested):
         """Decide the update schedule. ``MXTPU_SHARD_UPDATE=0/1`` overrides
         the argument; ``None`` = auto (on when shardable). A shard request
         the configuration cannot honor keeps the REPLICATED compiled path
         (not the eager fallback) and warns once per (reason, net)."""
+        if self.shard_params:
+            return  # FSDP owns the whole schedule; weights stay sharded
         env = os.environ.get("MXTPU_SHARD_UPDATE")
         if env is not None:
             requested = env.strip().lower() not in ("0", "false", "off", "")
@@ -326,15 +629,8 @@ class CompiledTrainStep:
             return
         if self.fallback_reason is not None:
             return  # the whole step already falls back to eager
-        opt = self.trainer._optimizer
-        n = self._dp_size()
-        if n < 2:
-            reason = "no mesh with a 'dp' axis of size >= 2"
-        elif not opt.supports_sharded_update:
-            reason = (f"{type(opt).__name__}'s recurrence is not "
-                      "elementwise (per-tensor reductions need the full "
-                      "tensor)")
-        else:
+        ok, reason = self._shardable()
+        if ok:
             self.shard_update = True
             return
         if auto and self.mesh is None:
@@ -425,6 +721,45 @@ class CompiledTrainStep:
                 for dt in sorted(by_dt)]
 
     def _build(self, x, y, pad=0):
+        """Trace + compile one program for this input signature. Under FSDP
+        the per-param buffers were released at adoption; re-traces need them
+        back (the deferred-compute variables must bind to the SAME NDArray
+        objects the forward reads), so builds are bracketed by
+        materialize/release."""
+        st = self._fsdp_state
+        if st is None:
+            return self._build_program(x, y, pad=pad)
+        st.materialize_into_params()
+        try:
+            return self._build_program(x, y, pad=pad)
+        finally:
+            st.release_params()
+
+    def _make_fsdp_groups(self, train_idx):
+        """Expand the partition rules over the named trainables and fold
+        them into the per-layer bucket schedule. Names come from the net's
+        ``collect_params`` keys (the structured 'encoder.layers.0...' paths
+        the rules are written against), falling back to ``Parameter.name``
+        for trainer params outside the net."""
+        from .parallel.partition import (fsdp_groups, fsdp_rules,
+                                         match_partition_rules)
+
+        tr = self.trainer
+        name_of = {id(p): pname
+                   for pname, p in self.net.collect_params().items()}
+        names = [name_of.get(id(tr._params[i]), tr._params[i].name)
+                 for i in train_idx]
+        rules = self.partition_rules if self.partition_rules is not None \
+            else fsdp_rules()
+        specs = match_partition_rules(
+            rules, {nm: tr._params[i].data()
+                    for nm, i in zip(names, train_idx)})
+        entries = [(k, nm, tuple(tr._params[i].data().shape),
+                    str(tr._params[i].data().dtype))
+                   for k, (nm, i) in enumerate(zip(names, train_idx))]
+        return fsdp_groups(entries, specs, self._dp_size())
+
+    def _build_program(self, x, y, pad=0):
         import jax
         import jax.numpy as jnp
         import numpy as onp
@@ -446,13 +781,28 @@ class CompiledTrainStep:
             self.fallback_reason = reason
             return None
         raw, state_keys, needs_t, _ = opt.fused_step
+        fsdp = self.shard_params
+        if self._shard_params_auto:
+            # deferred auto decision, now that shapes are known; sticky —
+            # every input signature's program shares one residency
+            self._shard_params_auto = False
+            if not fsdp:
+                total = sum(tr._params[i].data()._data.nbytes
+                            for i in train_idx)
+                thresh_mb = float(os.environ.get(
+                    "MXTPU_SHARD_PARAMS_AUTO_MB", "256"))
+                fsdp = total >= thresh_mb * (1 << 20)
+                self.shard_params = fsdp
+        if fsdp:
+            self.shard_update = False  # FSDP supersedes ZeRO-1
         sharded = self.shard_update
         # the flat-bucket ZeRO-1 schedule needs an elementwise recurrence
         # (it updates arbitrary chunk slices); other fused optimizers keep
         # the per-tensor psum update on a mesh
-        bucketed = self.mesh is not None and opt.supports_sharded_update
+        bucketed = self.mesh is not None and opt.supports_sharded_update \
+            and not fsdp
         for i in train_idx:
-            if not sharded and tr._states[i] is None:
+            if not sharded and not fsdp and tr._states[i] is None:
                 tr._states[i] = opt.create_state_multi_precision(
                     i, tr._params[i].data())
             if tr._states[i] is not None and \
@@ -482,6 +832,20 @@ class CompiledTrainStep:
             self._shard_state = _ShardedOptState(
                 self.mesh, opt, tr, train_idx, buckets, state_keys)
             tr._shard_state = self._shard_state
+        groups = None
+        remat = None
+        if fsdp:
+            groups = self._fsdp_groups
+            if groups is None:
+                groups = self._make_fsdp_groups(train_idx)
+                self._fsdp_groups = groups
+            remat = os.environ.get("MXTPU_FSDP_REMAT",
+                                   "dots").strip().lower()
+            if remat not in ("dots", "full", "none"):
+                raise MXNetError(
+                    f"MXTPU_FSDP_REMAT={remat!r}: expected 'dots' (save "
+                    "dot outputs), 'full' (save nothing) or 'none' (no "
+                    "rematerialization)")
 
         # --- capture the forward+loss graph (the hybridize machinery) ------
         if weighted:
@@ -538,6 +902,40 @@ class CompiledTrainStep:
                 # per-shard dropout masks: fold the shard index into the key
                 key = jax.random.fold_in(key, coll.axis_index("dp"))
 
+            if fsdp:
+                from .parallel import collectives as coll
+
+                def expand(w_tuple):
+                    # JIT weight materialization: all_gather each layer's
+                    # flat shard right where the forward needs it; the
+                    # transpose of these gathers IS the gradient
+                    # psum_scatter, so grads come back pre-reduced in the
+                    # owning shard's layout
+                    full = [None] * n_train
+                    for (_, _, ks, bs, sh), buf in zip(groups, w_tuple):
+                        flat = coll.all_gather(buf, "dp", axis=0,
+                                               tiled=True) if sh else buf
+                        for k, arr in zip(ks, bs.unflatten(flat)):
+                            full[k] = arr
+                    return full
+
+                def wrap(lfn):
+                    # rematerialize the forward in the backward so full
+                    # weights are re-gathered, not kept live; 'dots' saves
+                    # matmul outputs (activations), the classic FSDP policy
+                    if remat == "none":
+                        return lfn
+                    if remat == "full":
+                        return jax.checkpoint(lfn)
+                    return jax.checkpoint(
+                        lfn, policy=jax.checkpoint_policies.dots_saveable)
+            else:
+                def expand(w_tuple):
+                    return list(w_tuple)
+
+                def wrap(lfn):
+                    return lfn
+
             if weighted:
                 from .parallel import collectives as coll
 
@@ -549,13 +947,13 @@ class CompiledTrainStep:
 
                 def lfn(w_tuple):
                     args = ([key] if uses_rng else []) + [xb, yb] + \
-                        list(w_tuple) + list(fs)
+                        expand(w_tuple) + list(fs)
                     outs = fwd(*args)
                     return (jnp.sum(outs[0] * wv),) + tuple(outs[1:])
 
                 # cotangent pre-divided by the true example count: local
                 # grads then SUM-reduce to the full gradient
-                outs, (grads,) = ag.program_vjp(lfn, (tuple(ws),),
+                outs, (grads,) = ag.program_vjp(wrap(lfn), (tuple(ws),),
                                                 loss_scale / wsum)
                 loss_v = outs[0] / wsum
                 aux = list(outs[1:])
@@ -565,12 +963,12 @@ class CompiledTrainStep:
             else:
                 def lfn(w_tuple):
                     args = ([key] if uses_rng else []) + [xb, yb] + \
-                        list(w_tuple) + list(fs)
+                        expand(w_tuple) + list(fs)
                     return fwd(*args)
 
                 # backward INSIDE the trace, seeded with the loss scale so a
                 # DynamicLossScaler update never retraces (program_vjp)
-                outs, (grads,) = ag.program_vjp(lfn, (tuple(ws),),
+                outs, (grads,) = ag.program_vjp(wrap(lfn), (tuple(ws),),
                                                 loss_scale)
                 loss_v, aux = outs[0], list(outs[1:])
                 if mesh is not None:
@@ -583,6 +981,10 @@ class CompiledTrainStep:
 
                 aux = [coll.all_reduce(a, "dp", op="mean") for a in aux]
 
+            if fsdp:
+                upd = _fsdp_update(
+                    ws, ss, grads, lrs, wds, ts, rescale, grad_op)
+                return (loss_v, tuple(aux)) + upd
             if bucketed:
                 upd = _bucket_update(
                     ws, ss, grads, lrs, wds, ts, rescale, grad_op)
@@ -661,21 +1063,6 @@ class CompiledTrainStep:
             overflow = jnp.logical_not(finite)
             new_ws = [None] * n_train
             new_ss = []
-            def run_chunk(w_c, st_c, g_c, lr_c, wd_c, t_c):
-                args = [w_c, *st_c, g_c * rescale, lr_c, wd_c]
-                if needs_t:
-                    args.append(t_c)
-                out = raw(*args)
-                if n_state:
-                    nw, ns = out[0], tuple(out[1:])
-                else:
-                    nw, ns = out, ()
-                if scaler_on:
-                    nw = jnp.where(overflow, w_c, nw)
-                    ns = tuple(jnp.where(overflow, s0, s1)
-                               for s0, s1 in zip(st_c, ns))
-                return nw, ns
-
             for bi, ((_, ks, bs), g) in enumerate(zip(buckets, gred)):
                 ksel = jnp.asarray(ks)
                 w_in = bs.flatten([ws[k] for k in ks])
@@ -685,12 +1072,74 @@ class CompiledTrainStep:
                 # (the pad region is all-zero and discarded)
                 t_v = bs.spread(ts[ksel], pad_value=1.0) if needs_t else None
                 sl = lambda v: bs.shard_slice(v, "dp")  # noqa: E731
-                nw, ns = run_chunk(sl(w_in), ss[bi], g, sl(lr_v), sl(wd_v),
-                                   sl(t_v) if needs_t else None)
+                nw, ns = _apply_chunk(sl(w_in), ss[bi], g, sl(lr_v),
+                                      sl(wd_v),
+                                      sl(t_v) if needs_t else None,
+                                      rescale, overflow)
                 flat_nw = coll.all_gather(nw, "dp", axis=0, tiled=True)
                 new_ss.append(ns)
                 for k, arr in zip(ks, bs.unflatten(flat_nw)):
                     new_ws[k] = arr
+            return new_ws, tuple(new_ss), overflow
+
+        def _apply_chunk(w_c, st_c, g_c, lr_c, wd_c, t_c, rescale, overflow):
+            """Run the recurrence on one flat chunk (a ZeRO-1 bucket shard
+            or an FSDP group shard) with per-element hypers, applying the
+            skip-on-overflow select — the one code path every flat-bucket
+            schedule updates through."""
+            args = [w_c, *st_c, g_c * rescale, lr_c, wd_c]
+            if needs_t:
+                args.append(t_c)
+            out = raw(*args)
+            if n_state:
+                nw, ns = out[0], tuple(out[1:])
+            else:
+                nw, ns = out, ()
+            if scaler_on:
+                nw = jnp.where(overflow, w_c, nw)
+                ns = tuple(jnp.where(overflow, s0, s1)
+                           for s0, s1 in zip(st_c, ns))
+            return nw, ns
+
+        def _fsdp_update(ws, ss, grads, lrs, wds, ts, rescale, grad_op):
+            """The FSDP update: ``ws``/``ss`` are the resident per-group
+            bucket shards and ``grads`` arrived PRE-SCATTERED for sharded
+            groups (the vjp transpose of the forward's tiled all_gather is
+            psum_scatter) — sum-reduced, so mean semantics divide by the dp
+            extent. Replicated pools all_reduce their local grads instead.
+            The recurrence runs on each group's shard and the outputs STAY
+            sharded: no trailing weight all-gather — the next step's
+            forward gathers just-in-time again."""
+            from .parallel import collectives as coll
+
+            gred, finite = [], jnp.bool_(True)
+            for (_, _, ks, bs, sh), g in zip(groups, grads):
+                if sh:
+                    if grad_op == "mean":
+                        g = g / n_dp  # pmean == psum / N, elementwise
+                else:
+                    g = coll.all_reduce(g, "dp", op=grad_op)
+                gred.append(g)
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+            # each replica inspected only its shards: AND the verdicts so
+            # the where-select agrees everywhere
+            finite = coll.all_reduce(finite.astype(jnp.int32), "dp",
+                                     op="min") > 0
+            overflow = jnp.logical_not(finite)
+            new_ws, new_ss = [], []
+            for gi, ((_, _, ks, bs, sh), g) in enumerate(zip(groups, gred)):
+                ksel = jnp.asarray(ks)
+                lr_v = bs.spread(lrs[ksel])
+                wd_v = bs.spread(wds[ksel])
+                t_v = bs.spread(ts[ksel], pad_value=1.0) if needs_t else None
+                if sh:
+                    sl = lambda v: bs.shard_slice(v, "dp")  # noqa: E731
+                    lr_v, wd_v = sl(lr_v), sl(wd_v)
+                    t_v = sl(t_v) if needs_t else None
+                nw, ns = _apply_chunk(ws[gi], ss[gi], g, lr_v, wd_v, t_v,
+                                      rescale, overflow)
+                new_ws.append(nw)
+                new_ss.append(ns)
             return new_ws, tuple(new_ss), overflow
 
         fn = body
@@ -698,13 +1147,26 @@ class CompiledTrainStep:
             from .parallel.mesh import P, shard_map_compat
 
             dp = P("dp")
-            ss_spec = dp if bucketed else P()
-            out_state = dp if bucketed else P()
+            if fsdp:
+                # per-leaf spec pytrees: sharded groups enter/leave as
+                # their 1/N shards, replicated pools as full copies
+                ws_spec = [dp if sh else P()
+                           for _, _, _, _, sh in groups]
+                ss_spec = tuple(dp if sh else P()
+                                for _, _, _, _, sh in groups)
+                out_ws = list(ws_spec)
+                out_state = ss_spec
+            else:
+                ws_spec = P()
+                ss_spec = dp if bucketed else P()
+                out_ws = P()
+                out_state = dp if bucketed else P()
             inner = shard_map_compat(
                 body, mesh,
-                in_specs=(P(), ss_spec, P(), dp, dp, dp if weighted else P(),
+                in_specs=(ws_spec, ss_spec, P(), dp, dp,
+                          dp if weighted else P(),
                           P(), P(), P(), P(), P(), P()),
-                out_specs=(P(), P(), P(), out_state, P()))
+                out_specs=(P(), P(), out_ws, out_state, P()))
             if weighted:
                 b = int(x.shape[0])
 
@@ -734,9 +1196,24 @@ class CompiledTrainStep:
 
             fn = no_mesh
         coll_bytes = self._collective_bytes(train_idx, aux_targets, buckets,
-                                            bucketed, weighted, scaler_on)
+                                            bucketed, weighted, scaler_on,
+                                            groups=groups, remat=remat)
+        if fsdp and self._fsdp_state is None:
+            # adoption AFTER the trace (it releases the per-param buffers
+            # the trace just bound); like the ZeRO-1 state, the residency
+            # is per-net — every input signature's program shares it
+            self._fsdp_state = _FSDPState(self.mesh, opt, tr, train_idx,
+                                          groups, state_keys)
+            tr._shard_state = self._fsdp_state
+            gathers = 1 if remat == "none" else 2  # backward re-gather
+            self._fsdp_layer_bytes = tuple(
+                (layer,
+                 bs.padded * onp.dtype(dt).itemsize * gathers if sh else 0,
+                 bs.padded * onp.dtype(dt).itemsize if sh else 0)
+                for layer, dt, _, bs, sh in groups)
         return _Program(jax.jit(fn, donate_argnums=(0, 1)), uses_rng,
-                        aux_targets, sharded=bucketed, coll_bytes=coll_bytes)
+                        aux_targets, sharded=bucketed, fsdp=fsdp,
+                        coll_bytes=coll_bytes)
 
     @staticmethod
     def _pad_rows(arr, pad):
@@ -750,11 +1227,13 @@ class CompiledTrainStep:
             arr._data, ((0, pad),) + ((0, 0),) * (arr._data.ndim - 1)))
 
     def _collective_bytes(self, train_idx, aux_targets, buckets, bucketed,
-                          weighted, scaler_on):
+                          weighted, scaler_on, groups=None, remat=None):
         """Statically-known per-step IN-PROGRAM collective payload (per
         replica): the dispatch site reports these since the host cannot
         observe in-program collectives. Replicated state residency adds
-        its host-side scatter/gather resharding on top (in ``_run``)."""
+        its host-side scatter/gather resharding on top (in ``_run``).
+        FSDP numbers are schedule-level (what the trace emits; XLA may CSE
+        backward re-gathers)."""
         if self.mesh is None:
             return (0, 0, 0)
         import numpy as onp
@@ -769,6 +1248,18 @@ class CompiledTrainStep:
         psum = 4 + aux_b  # loss scalar + BN stat means
         if weighted:
             psum += 4  # example-weight sum
+        if groups is not None:  # FSDP
+            rs = ag = 0
+            gathers = 1 if remat == "none" else 2  # backward re-gather
+            for _, dt, _, bs, sh in groups:
+                b = bs.padded * onp.dtype(dt).itemsize
+                if sh:
+                    ag += b * gathers  # JIT weight gather(s)
+                    rs += b            # grad psum_scatter (vjp transpose)
+                else:
+                    psum += b          # replicated-pool grad all_reduce
+            psum += 4  # the AND-reduced finiteness verdict
+            return (rs, ag, psum)
         if not bucketed:
             # non-elementwise fused optimizer: per-tensor grad psum
             grad_b = sum(nbytes(self.trainer._params[i].data().shape,
@@ -812,15 +1303,22 @@ class CompiledTrainStep:
         idxs = self._train_idx
         keys = self._state_keys
         scaler = self.loss_scaler
-        ws = [tr._params[i].data()._data for i in idxs]
-        if prog.sharded and self.shard_update:
+        if prog.fsdp:
+            # FSDP: weights AND state are the resident bucket shards; no
+            # full-sized value is ever assembled on the host
+            ws = list(self._fsdp_state.params)
+            ss = tuple(self._fsdp_state.state)
+        elif prog.sharded and self.shard_update:
+            ws = [tr._params[i].data()._data for i in idxs]
             ss = tuple(self._shard_state.state)
         elif prog.sharded:
+            ws = [tr._params[i].data()._data for i in idxs]
             # replicated residency: scatter per-param state into the same
             # dp-sharded bucket arrays the sharded mode feeds — the ONE
             # program both modes dispatch (the parity contract)
             ss = self._scatter_replicated_state()
         else:
+            ws = [tr._params[i].data()._data for i in idxs]
             ss = [tuple(tr._states[i][k]._data for k in keys) for i in idxs]
         fs = [p.data()._data for _, p in self._frozen]
         if prog.uses_rng:
@@ -852,6 +1350,8 @@ class CompiledTrainStep:
                 rs_b += self._state_bucket_bytes
                 ag_b += self._state_bucket_bytes
             _telemetry.record_collective(rs_b, ag_b, ps_b)
+            if prog.fsdp:
+                _telemetry.record_fsdp(self._fsdp_layer_bytes)
             with _telemetry.program_timer("train_step"):
                 out = prog.fn(ws, ss, fs, x._data, y._data, key, lrs, wds,
                               ts, rescale, loss_scale)
@@ -859,11 +1359,17 @@ class CompiledTrainStep:
             out = prog.fn(ws, ss, fs, x._data, y._data, key, lrs, wds, ts,
                           rescale, loss_scale)
         loss_v, aux, new_ws, new_ss, overflow = out
-        for k, i in enumerate(idxs):
-            tr._params[i].data()._set_data(new_ws[k])
-        if prog.sharded and self.shard_update:
+        if prog.fsdp:
+            # outputs ARE the updated bucket shards: no per-param weight
+            # writeback exists (or is wanted) — rebind the residency
+            self._fsdp_state.rebind(new_ws, new_ss)
+        elif prog.sharded and self.shard_update:
+            for k, i in enumerate(idxs):
+                tr._params[i].data()._set_data(new_ws[k])
             self._shard_state.rebind(new_ss)
         elif prog.sharded:
+            for k, i in enumerate(idxs):
+                tr._params[i].data()._set_data(new_ws[k])
             # gather updated shard buckets back into the per-param arrays
             for (_, ks, bs), st in zip(self._buckets, new_ss):
                 for key, flat in zip(keys, st):
@@ -873,6 +1379,7 @@ class CompiledTrainStep:
                             flat[off:off + n].reshape(shape))
         else:
             for k, i in enumerate(idxs):
+                tr._params[i].data()._set_data(new_ws[k])
                 for sk, arr in zip(keys, new_ss[k]):
                     tr._states[i][sk]._set_data(arr)
         # aux write-backs happen regardless of overflow: BN stats update

@@ -1,0 +1,151 @@
+"""Regex partition rules (ISSUE 6): match_partition_rules over named
+parameter trees, and the fsdp_groups bucket schedule derived from them.
+
+Covers: scalar/size-1 leaves bypass the rules (always PS()); first
+matching rule wins over later ones; an unmatched leaf raises MXNetError
+naming the offending path; rules composing with the five_axis tp/pp specs
+on one mesh vocabulary; fsdp_groups layer/dtype grouping, replicated
+pooling, and the rejection of non-dp axes inside compile_step.
+"""
+import numpy as onp
+import pytest
+
+from jax.sharding import PartitionSpec as PS
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel import (fsdp_rules, match_partition_rules,
+                                named_tree_map, spec_axes)
+from mxnet_tpu.parallel.partition import fsdp_groups, layer_key
+
+
+def _arr(*shape):
+    return onp.zeros(shape, onp.float32)
+
+
+# -- named_tree_map ----------------------------------------------------------
+def test_named_tree_map_paths_and_structure():
+    tree = {"a": {"b": 1, "c": [2, 3]}, "d": (4,)}
+    paths = []
+    out = named_tree_map(lambda p, v: paths.append(p) or v * 10, tree)
+    assert sorted(paths) == ["a/b", "a/c/0", "a/c/1", "d/0"]
+    assert out == {"a": {"b": 10, "c": [20, 30]}, "d": (40,)}
+    assert isinstance(out["d"], tuple) and isinstance(out["a"]["c"], list)
+
+
+# -- match_partition_rules ---------------------------------------------------
+def test_scalar_and_size_one_leaves_never_partition():
+    """Scalars and size-1 tensors get PS() without consulting the rules —
+    even a catch-all PS('dp') rule cannot shard them."""
+    tree = {"scale": 3.0, "one": _arr(1), "onexone": _arr(1, 1),
+            "w": _arr(16, 4)}
+    specs = match_partition_rules(fsdp_rules(), tree)
+    assert specs["scale"] == PS()
+    assert specs["one"] == PS()
+    assert specs["onexone"] == PS()
+    assert specs["w"] == PS("dp")
+
+
+def test_first_matching_rule_wins():
+    """Rules are ordered: a specific rule listed before the catch-all takes
+    precedence even though the catch-all also matches."""
+    rules = (
+        (r"embed", PS()),               # keep embeddings replicated
+        (r"\bbias\b", PS()),
+        (r".*", PS("dp")),
+    )
+    tree = {"embed/weight": _arr(100, 8),
+            "dense/weight": _arr(8, 8),
+            "dense/bias": _arr(8)}
+    specs = match_partition_rules(rules, tree)
+    assert specs["embed/weight"] == PS()
+    assert specs["dense/bias"] == PS()
+    assert specs["dense/weight"] == PS("dp")
+
+
+def test_unmatched_leaf_raises_naming_path():
+    rules = ((r"weight", PS("dp")),)
+    tree = {"layer": {"weight": _arr(4, 4), "gamma": _arr(4)}}
+    with pytest.raises(MXNetError, match=r"layer/gamma"):
+        match_partition_rules(rules, tree)
+
+
+def test_unresolved_shape_raises():
+    class Deferred:
+        shape = (0, 16)
+
+    with pytest.raises(MXNetError, match="unresolved shape"):
+        match_partition_rules(fsdp_rules(), {"w": Deferred()})
+
+
+def test_composes_with_five_axis_tp_specs():
+    """five_axis layouts are just PartitionSpecs over named mesh axes, so
+    rules mixing dp with tp/pp expand through the same matcher: one rule
+    set can describe an FSDP+TP layout on one mesh."""
+    from mxnet_tpu.parallel.five_axis import five_axis_specs
+
+    fa = five_axis_specs(n_heads=4)
+    rules = (
+        (r"\bwq\b", fa["wq"]),          # P("pp", None, "tp")
+        (r"\bwo\b", fa["wo"]),          # P("pp", "tp", None)
+        (r".*", PS("dp")),
+    )
+    tree = {"stages": {"wq": _arr(2, 8, 8), "wo": _arr(2, 8, 8)},
+            "out_w": _arr(8, 4)}
+    specs = match_partition_rules(rules, tree)
+    assert specs["stages"]["wq"] == PS("pp", None, "tp")
+    assert specs["stages"]["wo"] == PS("pp", "tp", None)
+    assert specs["out_w"] == PS("dp")
+    assert spec_axes(specs["stages"]["wq"]) == {"pp", "tp"}
+    assert spec_axes(specs["out_w"]) == {"dp"}
+
+
+def test_spec_axes_handles_tuple_entries():
+    assert spec_axes(PS(("dp", "sp"), None, "tp")) == {"dp", "sp", "tp"}
+    assert spec_axes(PS()) == set()
+
+
+# -- fsdp_groups -------------------------------------------------------------
+def test_layer_key_granule():
+    assert layer_key("encoder.layers.0.attn.weight") == "encoder.layers.0.attn"
+    assert layer_key("encoder.layers.0.attn.bias") == "encoder.layers.0.attn"
+    assert layer_key("gamma") == "gamma"
+
+
+def test_fsdp_groups_layer_buckets_and_replicated_pool():
+    """weight+bias of one layer fold into one bucket; scalars/replicated
+    leaves pool under '_replicated' with n_shards=1; schedule preserves
+    first-appearance order."""
+    entries = [
+        (0, "0.weight", (16, 8), "float32"),
+        (1, "0.bias", (16,), "float32"),
+        (2, "1.weight", (4, 16), "float32"),
+        (3, "1.bias", (4,), "float32"),
+        (4, "scale", (), "float32"),
+    ]
+    specs = {"0.weight": PS("dp"), "0.bias": PS("dp"),
+             "1.weight": PS("dp"), "1.bias": PS("dp"),
+             "scale": PS()}
+    groups = fsdp_groups(entries, specs, n_shards=8)
+    assert [(g[0], g[2], g[4]) for g in groups] == [
+        ("0", [0, 1], True), ("1", [2, 3], True),
+        ("_replicated", [4], False)]
+    bs0 = groups[0][3]
+    assert bs0.total == 16 * 8 + 16
+    assert bs0.padded % 8 == 0 and bs0.n_shards == 8
+    assert groups[2][3].n_shards == 1  # replicated pool: no shard split
+
+
+def test_fsdp_groups_split_by_dtype():
+    entries = [(0, "0.weight", (8, 8), "float32"),
+               (1, "0.scale", (8,), "bfloat16")]
+    specs = {"0.weight": PS("dp"), "0.scale": PS("dp")}
+    groups = fsdp_groups(entries, specs, n_shards=8)
+    assert len(groups) == 2
+    assert {g[1] for g in groups} == {"float32", "bfloat16"}
+
+
+def test_fsdp_groups_rejects_non_dp_axes():
+    entries = [(0, "wq", (8, 8), "float32")]
+    specs = {"wq": PS(None, "tp")}
+    with pytest.raises(MXNetError, match="five_axis"):
+        fsdp_groups(entries, specs, n_shards=8)

@@ -378,3 +378,417 @@ def test_small_mesh_smoke():
     assert all(onp.isfinite(v) for v in losses)
     for row in tm.step_report(reset=True):
         assert row["dispatches"] == 1 and row["recompiles"] == 0, row
+
+
+# ===========================================================================
+# Full-parameter sharding (shard_params=True — FSDP / ZeRO-3), ISSUE 6
+# ===========================================================================
+def _run_fsdp_vs_replicated(n_steps=10, bn=False, seed=30, **compile_kw):
+    """Train the same net twice — shard_params=True vs fully replicated —
+    under an LR schedule; return (net, trainer, step, losses) per mode."""
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    batches = [_batch(seed=s) for s in range(n_steps)]
+
+    def run(shard):
+        net = _make_net(seed=seed, bn=bn)
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-3, "wd": 1e-3,
+                            "lr_scheduler": FactorScheduler(step=3,
+                                                            factor=0.5)})
+        step = tr.compile_step(net, loss_fn, mesh=make_mesh({"dp": 8}),
+                               shard_params=shard, shard_update=False,
+                               **compile_kw)
+        assert step.fallback_reason is None
+        assert step.shard_params is shard
+        losses = [float(step(x, y).asnumpy()) for x, y in batches]
+        return net, tr, step, losses
+
+    return run(True), run(False)
+
+
+@pytest.mark.parametrize("bn", [False, True])
+def test_fsdp_tolerance_parity_10_steps(bn):
+    """FSDP dispatches a structurally different program (JIT per-layer
+    all_gathers, psum_scatter'd grads, sharded update) so the contract is
+    numerical tolerance, not the bitwise parity ZeRO-1 gives — see
+    DESIGN.md. 10 steps under an LR schedule track the replicated
+    trajectory to float32 tolerance, BN running stats included."""
+    (net_s, _, step_s, losses_s), (net_r, _, _, losses_r) = \
+        _run_fsdp_vs_replicated(bn=bn, seed=30 + bn)
+    assert all(onp.isfinite(v) for v in losses_s)
+    assert onp.allclose(losses_s, losses_r, rtol=1e-4, atol=1e-5), \
+        onp.abs(onp.array(losses_s) - onp.array(losses_r)).max()
+    assert step_s._traces == 1  # LR schedule decayed: still one program
+    for (name, pa), (_, pb) in zip(net_s.collect_params().items(),
+                                   net_r.collect_params().items()):
+        a, b = pa.data().asnumpy(), pb.data().asnumpy()
+        assert onp.allclose(a, b, rtol=1e-4, atol=1e-5), \
+            f"{name}: maxdiff={onp.abs(a - b).max():.3e}"
+
+
+def test_fsdp_one_dispatch_and_residency_gauges():
+    """Acceptance: one dispatch per step, zero recompiles post-warmup, and
+    the residency gauges show params, grads AND optimizer state at ~1/8
+    per replica (exactly the padded shard bytes)."""
+    net = _make_net(seed=40)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), shard_params=True)
+    assert step.shard_params is True
+    assert step.shard_update is False  # FSDP supersedes ZeRO-1
+    x, y = _batch()
+    step(x, y)  # warmup: trace + compile + adoption
+    tm.enable()
+    tm.step_report(reset=True)
+    for s in (1, 2, 3):
+        step(*_batch(seed=s))
+    for row in tm.step_report(reset=True):
+        assert row["dispatches"] == 1 and row["recompiles"] == 0, row
+
+    st = step._fsdp_state
+    per_p = tm.gauge("train_step.param_bytes_per_replica").value
+    rep_p = tm.gauge("train_step.param_bytes_replicated").value
+    per_st = tm.gauge("train_step.opt_state_bytes_per_replica").value
+    rep_st = tm.gauge("train_step.opt_state_bytes_replicated").value
+    assert per_p == st.per_replica_param_bytes() > 0
+    assert rep_p == st.replicated_param_bytes() > 0
+    pad_p = sum(
+        (bs.padded - bs.total) * onp.dtype(dt).itemsize
+        for _, dt, _, bs, sh in st.groups if sh)
+    assert per_p <= rep_p / 8 + pad_p
+    n_keys = len(step._state_keys)
+    pad_st = sum((bs.padded - bs.total) * 4
+                 for _, _, _, bs, sh in st.groups if sh) * n_keys
+    assert 0 < per_st <= rep_st / 8 + pad_st
+    assert tm.gauge("train_step.grad_bytes_per_replica").value == per_p
+
+
+def test_fsdp_auto_threshold_env(monkeypatch):
+    """shard_params=None is auto: on once the trainables reach
+    MXTPU_SHARD_PARAMS_AUTO_MB. At 0 MiB even the toy net qualifies; at
+    the 256 MiB default it stays on the ZeRO-1 schedule."""
+    monkeypatch.setenv("MXTPU_SHARD_PARAMS_AUTO_MB", "0")
+    net = _make_net(seed=41)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}))
+    x, y = _batch()
+    step(x, y)  # the auto decision lands at first build
+    assert step.shard_params is True
+
+    monkeypatch.delenv("MXTPU_SHARD_PARAMS_AUTO_MB")
+    net2 = _make_net(seed=42)
+    tr2 = gluon.Trainer(net2.collect_params(), "adam",
+                        {"learning_rate": 1e-3})
+    step2 = tr2.compile_step(net2, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mesh=make_mesh({"dp": 8}))
+    step2(x, y)
+    assert step2.shard_params is False
+    assert step2.shard_update is True  # auto ZeRO-1 still applies
+
+
+def test_fsdp_env_override(monkeypatch):
+    """MXTPU_SHARD_PARAMS=0 vetoes an explicit shard_params=True (and the
+    step still trains); =1 forces FSDP on without the argument."""
+    monkeypatch.setenv("MXTPU_SHARD_PARAMS", "0")
+    net = _make_net(seed=43)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), shard_params=True)
+    assert step.shard_params is False
+    x, y = _batch()
+    assert onp.isfinite(float(step(x, y).asnumpy()))
+
+    monkeypatch.setenv("MXTPU_SHARD_PARAMS", "1")
+    net2 = _make_net(seed=44)
+    tr2 = gluon.Trainer(net2.collect_params(), "adam",
+                        {"learning_rate": 1e-3})
+    step2 = tr2.compile_step(net2, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mesh=make_mesh({"dp": 8}), shard_params=False)
+    assert step2.shard_params is True
+    assert onp.isfinite(float(step2(x, y).asnumpy()))
+
+
+def test_fsdp_fallback_non_elementwise_warns():
+    """LAMB's trust ratio needs whole tensors: an explicit shard_params
+    request keeps the unsharded residency with a once-per-net warning and
+    the reason recorded."""
+    net = _make_net(seed=45)
+    tr = gluon.Trainer(net.collect_params(), "lamb", {"learning_rate": 1e-3})
+    with pytest.warns(RuntimeWarning, match="not\\s+elementwise"):
+        step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                               mesh=make_mesh({"dp": 8}), shard_params=True)
+    assert step.shard_params is False
+    assert "elementwise" in step.shard_params_fallback_reason
+    x, y = _batch()
+    assert onp.isfinite(float(step(x, y).asnumpy()))
+
+
+def test_fsdp_partition_rules_replicated_pool():
+    """Custom rules keep biases replicated: they pool into the
+    '_replicated' group (updated identically on every shard) while the
+    weights stay 1/8; training still tracks the replicated trajectory."""
+    from jax.sharding import PartitionSpec as PS
+
+    rules = ((r"\bbias\b", PS()), (r".*", PS("dp")))
+    (net_s, _, step_s, losses_s), (_, _, _, losses_r) = \
+        _run_fsdp_vs_replicated(n_steps=5, seed=46, partition_rules=rules)
+    assert onp.allclose(losses_s, losses_r, rtol=1e-4, atol=1e-5)
+    layers = {g[0]: g[4] for g in step_s._fsdp_groups}
+    assert layers.pop("_replicated") is False
+    assert all(layers.values())  # every weight bucket sharded
+
+
+def test_fsdp_per_layer_gather_counters():
+    """Each dispatch books the build-time per-layer collective schedule:
+    under the default remat=dots every sharded layer all_gathers twice
+    (forward + backward re-gather) and psum_scatters its grads once."""
+    net = _make_net(seed=47)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), shard_params=True)
+    x, y = _batch()
+    step(x, y)  # warmup
+    assert step._fsdp_layer_bytes
+    tm.enable()
+    step(x, y)
+    for layer, gather_b, scatter_b in step._fsdp_layer_bytes:
+        assert tm.counter(f"fsdp.gather_bytes.{layer}").value == gather_b
+        assert tm.counter(f"fsdp.scatter_bytes.{layer}").value == scatter_b
+    for (_, dt, _, bs, sh), (_, gather_b, scatter_b) in zip(
+            step._fsdp_state.groups, step._fsdp_layer_bytes):
+        item = onp.dtype(dt).itemsize
+        assert gather_b == (bs.padded * item * 2 if sh else 0)
+        assert scatter_b == (bs.padded * item if sh else 0)
+
+
+def test_fsdp_remat_modes(monkeypatch):
+    """MXTPU_FSDP_REMAT=none books one gather per layer (no backward
+    re-gather) and still trains; an unknown mode raises."""
+    monkeypatch.setenv("MXTPU_FSDP_REMAT", "none")
+    net = _make_net(seed=48)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), shard_params=True)
+    x, y = _batch()
+    assert onp.isfinite(float(step(x, y).asnumpy()))
+    for (_, dt, _, bs, sh), (_, gather_b, _) in zip(
+            step._fsdp_state.groups, step._fsdp_layer_bytes):
+        assert gather_b == (bs.padded * onp.dtype(dt).itemsize if sh else 0)
+
+    monkeypatch.setenv("MXTPU_FSDP_REMAT", "bogus")
+    net2 = _make_net(seed=49)
+    tr2 = gluon.Trainer(net2.collect_params(), "adam",
+                        {"learning_rate": 1e-3})
+    step2 = tr2.compile_step(net2, gluon.loss.SoftmaxCrossEntropyLoss(),
+                             mesh=make_mesh({"dp": 8}), shard_params=True)
+    with pytest.raises(MXNetError, match="MXTPU_FSDP_REMAT"):
+        step2(x, y)
+
+
+def test_fsdp_overflow_skip_on_shards():
+    """DynamicLossScaler under FSDP: an overflow step leaves the sharded
+    weights AND optimizer state untouched (finiteness AND-reduced across
+    shards), halves the scale, and does not advance the schedule."""
+    net = _make_net(seed=50)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    sc = amp.attach_loss_scaler(tr, DynamicLossScaler(init_scale=1024.0))
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), shard_params=True)
+    x, y = _batch(seed=21)
+    step(x, y)  # clean step: trains
+    snap_w = {n: p.data().asnumpy().copy()
+              for n, p in net.collect_params().items()}
+    snap_st = [None if st is None else {k: v.asnumpy() for k, v in st.items()}
+               for st in tr._shard_state.gather_states()]
+    x_bad = mx.nd.array(onp.full(tuple(x.shape), onp.inf, onp.float32))
+    step(x_bad, y)
+    for n, p in net.collect_params().items():
+        assert _bits_equal(p.data().asnumpy(), snap_w[n]), \
+            f"{n} moved on overflow"
+    for st0, st1 in zip(snap_st, tr._shard_state.gather_states()):
+        if st0 is None:
+            continue
+        for k in st0:
+            assert _bits_equal(st0[k], st1[k].asnumpy()), f"state {k} moved"
+    assert sc.loss_scale == 512.0
+    assert tr.optimizer.num_update == 1
+    step(x, y)
+    assert tr.optimizer.num_update == 2
+
+
+def test_fsdp_watchdog_silent_with_scaler_and_schedule():
+    """10 FSDP steps with an LR schedule AND a DynamicLossScaler: the
+    recompile watchdog stays silent (one program, one signature), one
+    dispatch per step."""
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    net = _make_net(seed=51)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3,
+                        "lr_scheduler": FactorScheduler(step=4, factor=0.5)})
+    amp.attach_loss_scaler(tr, DynamicLossScaler(init_scale=256.0))
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), shard_params=True)
+    x0, y0 = _batch()
+    step(x0, y0)  # warmup compile before arming the watchdog
+    tm.enable()
+    tm.step_report(reset=True)
+    losses = [float(step(*_batch(seed=s)).asnumpy()) for s in range(1, 10)]
+    rows = tm.step_report(reset=True)
+    assert all(onp.isfinite(v) for v in losses)
+    assert len(rows) == 9
+    for row in rows:
+        assert row["dispatches"] == 1 and row["recompiles"] == 0, row
+    assert tm.WATCHDOG.warnings_fired == 0
+    assert step._traces == 1
+
+
+# -- checkpointing across all three residency modes --------------------------
+def _make_mode(mode, seed=52):
+    net = _make_net(seed=seed)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}),
+                           shard_params=(mode == "fsdp"),
+                           shard_update=(mode == "zero1"))
+    return net, tr, step
+
+
+def _full_states(tr):
+    if tr._shard_state is not None:
+        return tr._shard_state.gather_states()
+    return tr._states
+
+
+@pytest.mark.parametrize("first,second", [
+    ("fsdp", "replicated"), ("replicated", "fsdp"),
+    ("fsdp", "zero1"), ("zero1", "fsdp")])
+def test_fsdp_checkpoint_roundtrip_bitwise(tmp_path, first, second):
+    """Checkpoints keep the classic per-param layout in every residency
+    mode: 3 steps in one mode, save (weights + trainer states), load into
+    another mode — weights and optimizer state restore BITWISE, and
+    training resumes."""
+    batches = [_batch(seed=s) for s in range(5)]
+    pfile = str(tmp_path / "net.params")
+    sfile = str(tmp_path / "trainer.states")
+
+    net_a, tr_a, step_a = _make_mode(first)
+    for x, y in batches[:3]:
+        step_a(x, y)
+    net_a.save_parameters(pfile)  # FSDP: materializes from shard buckets
+    tr_a.save_states(sfile)
+    w_snap = {n: p.data().asnumpy() for n, p in
+              net_a.collect_params().items()}
+    st_snap = [None if st is None else {k: v.asnumpy()
+                                        for k, v in st.items()}
+               for st in _full_states(tr_a)]
+
+    net_b, tr_b, step_b = _make_mode(second)
+    net_b(batches[0][0])  # settle shapes before load
+    if second == "fsdp":
+        step_b(*batches[0])  # adopt params into buckets, then write through
+    net_b.load_parameters(pfile)
+    tr_b.load_states(sfile)
+    for n, p in net_b.collect_params().items():
+        assert _bits_equal(p.data().asnumpy(), w_snap[n]), f"weight {n}"
+    for st0, st1 in zip(st_snap, _full_states(tr_b)):
+        if st0 is None:
+            continue
+        for k in st0:
+            assert _bits_equal(st0[k], st1[k].asnumpy()), f"state {k}"
+    for x, y in batches[3:]:
+        assert onp.isfinite(float(step_b(x, y).asnumpy()))
+    assert tr_b.optimizer.num_update == 5 if second != "fsdp" else True
+
+
+# -- BERT-class acceptance ---------------------------------------------------
+def test_fsdp_bert_class_acceptance():
+    """The ISSUE acceptance shape: a BERT-class encoder (embeddings +
+    transformer blocks + pooler + head) trains with shard_params=True in
+    ONE dispatch per step on the 8-way mesh, zero recompiles post-warmup
+    under an LR schedule, residency gauges at ~1/8, and the loss tracks
+    the replicated trajectory over 10 steps."""
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    class BertClassifier(gluon.HybridBlock):
+        def __init__(self, classes=4, **kw):
+            super().__init__(**kw)
+            self.bert = BERTModel(vocab_size=64, num_layers=2, units=32,
+                                  hidden_size=64, num_heads=4, max_length=16,
+                                  dropout=0.0)
+            self.head = nn.Dense(classes, in_units=32)
+
+        def forward(self, tokens):
+            _, pooled = self.bert(tokens)
+            return self.head(pooled)
+
+    rs = onp.random.RandomState(0)
+    batches = [(mx.nd.array(rs.randint(0, 64, (16, 12)).astype("int32")),
+                mx.nd.array(rs.randint(0, 4, (16,)).astype("float32")))
+               for _ in range(10)]
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(shard):
+        mx.random.seed(60)
+        net = BertClassifier()
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-4,
+                            "lr_scheduler": FactorScheduler(step=4,
+                                                            factor=0.5)})
+        step = tr.compile_step(net, loss_fn, mesh=make_mesh({"dp": 8}),
+                               shard_params=shard, shard_update=False)
+        assert step.fallback_reason is None, step.fallback_reason
+        losses = [float(step(x, y).asnumpy()) for x, y in batches[:1]]
+        tm.enable()
+        tm.step_report(reset=True)
+        losses += [float(step(x, y).asnumpy()) for x, y in batches[1:]]
+        rows = tm.step_report(reset=True)
+        tm.disable()
+        assert len(rows) == 9
+        for row in rows:
+            assert row["dispatches"] == 1, row
+            assert row["recompiles"] == 0, row
+        assert step._traces == 1
+        return step, losses
+
+    step_s, losses_s = run(True)
+    assert step_s.shard_params is True
+    per_p = step_s._fsdp_state.per_replica_param_bytes()
+    rep_p = step_s._fsdp_state.replicated_param_bytes()
+    pad_p = sum((bs.padded - bs.total) * onp.dtype(dt).itemsize
+                for _, dt, _, bs, sh in step_s._fsdp_state.groups if sh)
+    assert 0 < per_p <= rep_p / 8 + pad_p
+    # every transformer layer contributes its own gather/scatter granule
+    sharded_layers = [g[0] for g in step_s._fsdp_groups if g[4]]
+    assert len(sharded_layers) >= 4, sharded_layers
+
+    _, losses_r = run(False)
+    assert all(onp.isfinite(v) for v in losses_s)
+    assert onp.allclose(losses_s, losses_r, rtol=5e-4, atol=5e-5), \
+        onp.abs(onp.array(losses_s) - onp.array(losses_r)).max()
+
+
+# -- bench wiring ------------------------------------------------------------
+def test_bench_train_step_fsdp_small(monkeypatch):
+    """bench.py train_step --shard-params (small model): one dispatch per
+    step, no recompiles, param AND optimizer-state residency well under
+    the replicated bytes, collective traffic recorded."""
+    import bench
+
+    monkeypatch.setenv("BENCH_TRAIN_STEP_SMALL", "1")
+    r = bench.bench_train_step_fsdp()
+    assert r["dispatches_per_step"] == 1, r
+    assert r["recompiles_after_warmup"] == 0, r
+    assert r["compiled_programs"] == 1, r
+    assert r["dp_size"] == 8, r
+    assert 0 < r["param_bytes_per_replica"] < r["param_bytes_replicated"], r
+    assert 0 < r["opt_state_bytes_per_replica"] \
+        < r["opt_state_bytes_replicated"], r
+    assert r["grad_bytes_per_replica"] == r["param_bytes_per_replica"], r
+    assert r["collective_bytes_per_step"] > 0, r
+    assert r["value"] > 0 and r["vs_baseline"] > 0, r
