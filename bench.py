@@ -767,6 +767,119 @@ def bench_serve():
             "mfu": None}
 
 
+def bench_serve_llm():
+    """Continuous-batching decode (serve.decode.DecodeEngine): 64
+    concurrent clients with ragged prompt lengths streaming greedy tokens
+    from gpt_tiny, vs the same thread harness running the naive
+    per-request ``generate(use_cache=False)`` rolling-window loop.
+    Reports generated tokens/s both ways, the engine/naive ratio, p50/p99
+    TTFT and per-token latency from the telemetry Histograms, slot
+    occupancy, and compile counts — steady-state compiles after warmup()
+    must be 0. BENCH_SERVE_LLM_SMALL=1 shrinks clients/model for the
+    not-slow suite."""
+    import threading
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.gluon.model_zoo import gpt_tiny
+    from mxnet_tpu.serve.decode import DecodeEngine
+
+    small = os.environ.get("BENCH_SERVE_LLM_SMALL", "") == "1"
+    if small:
+        CLIENTS, MAX_NEW, SLOTS, UNITS, LAYERS, MAX_LEN, MAX_PROMPT = \
+            (8, 4, 4, 32, 2, 64, 12)
+    else:
+        CLIENTS, MAX_NEW, SLOTS, UNITS, LAYERS, MAX_LEN, MAX_PROMPT = \
+            (64, 16, 16, 64, 2, 128, 48)
+    VOCAB = 256
+
+    mx.random.seed(23)
+    net = gpt_tiny(vocab_size=VOCAB, dropout=0.0, num_layers=LAYERS,
+                   units=UNITS, num_heads=4, max_length=MAX_LEN)
+    net.initialize()
+    rs = onp.random.RandomState(7)
+    prompts = [[int(t) for t in rs.randint(1, VOCAB,
+                                           size=rs.randint(1, MAX_PROMPT))]
+               for _ in range(CLIENTS)]
+
+    def drive(worker):
+        # identical harness both ways: one thread per client, all released
+        # together; tokens/s over the joined wall clock
+        barrier = threading.Barrier(CLIENTS + 1)
+        errs, tokens = [], [0] * CLIENTS
+
+        def client(cid):
+            try:
+                barrier.wait()
+                tokens[cid] = len(worker(prompts[cid]))
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(CLIENTS)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        return sum(tokens) / dt, sum(tokens)
+
+    was_on = telemetry.is_enabled()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        # baseline: the naive rolling-window loop, one forward per token
+        def naive_worker(prompt):
+            out = net.generate(prompt, max_new_tokens=MAX_NEW,
+                               temperature=0.0, use_cache=False)
+            return out[len(prompt):]
+
+        naive_worker(prompts[0])  # warm the window program
+        naive_tps, _ = drive(naive_worker)
+
+        eng = DecodeEngine(net, num_slots=SLOTS, max_len=MAX_LEN,
+                           max_prompt_len=MAX_PROMPT,
+                           prefill_batch=min(SLOTS, 4),
+                           max_queue=2 * CLIENTS, cache_dir=False)
+        eng.warmup()
+        compiles_warmup = int(telemetry.metrics()["jit.compiles"])
+        # greedy parity spot check before timing anything
+        want = naive_worker(prompts[0])
+        got = eng.submit(prompts[0], max_new_tokens=MAX_NEW).result(120)
+        if got != [int(t) for t in want]:
+            raise AssertionError(
+                f"engine/naive greedy divergence: {got} vs {want}")
+
+        c0 = telemetry.metrics()["jit.compiles"]
+        engine_tps, n_tokens = drive(
+            lambda p: eng.submit(p, max_new_tokens=MAX_NEW).result(300))
+        compiles_steady = int(telemetry.metrics()["jit.compiles"] - c0)
+        st = eng.stats()
+        eng.close()
+    finally:
+        telemetry.enable() if was_on else telemetry.disable()
+
+    return {"metric": "serve_llm_continuous_batching",
+            "value": round(engine_tps, 1), "unit": "tok/s",
+            "vs_baseline": round(engine_tps / max(naive_tps, 1e-9), 3),
+            "naive_tok_per_sec": round(naive_tps, 1),
+            "clients": CLIENTS, "tokens": n_tokens,
+            "ticks": st["ticks"], "prefills": st["prefills"],
+            "mean_slot_occupancy": round(st["mean_slot_occupancy"], 3),
+            "ttft_ms_p50": st["ttft_ms_p50"],
+            "ttft_ms_p99": st["ttft_ms_p99"],
+            "tpot_ms_p50": st["tpot_ms_p50"],
+            "tpot_ms_p99": st["tpot_ms_p99"],
+            "shed": st["shed"], "evicted": st["evicted"],
+            "compiles_warmup": compiles_warmup,
+            "compiles_steady": compiles_steady,
+            "mfu": None}
+
+
 def _accel_expected():
     """True when this machine is configured for an accelerator, so a CPU
     result must be reported as a failure rather than published silently:
@@ -831,7 +944,8 @@ def main():
                                                        "large"),
               "optimizer_step": bench_optimizer_step,
               "telemetry_overhead": bench_telemetry_overhead,
-              "serve": bench_serve}[which]
+              "serve": bench_serve,
+              "serve_llm": bench_serve_llm}[which]
         # resolve the backend up front through the hardened probe: a hung
         # or dead TPU runtime must not kill the bench (round-1 failure:
         # raw RuntimeError) — and must not silently publish a CPU number

@@ -84,6 +84,7 @@ class CachedOp:
         from .ops.registry import _observe_compiles
 
         self.sym = sym
+        self._name = name
         self._var_nodes = list(var_nodes)
         self._aux_targets = [t for t, _ in aux_updates]
         entries = list(sym._entries) + [e for _, e in aux_updates]
@@ -94,6 +95,7 @@ class CachedOp:
         # of this program (a new input signature) reports one compile
         self._jitted = jax.jit(_observe_compiles(fn, f"cached_op:{name}",
                                                  None))
+        self._donated_jits = {}  # donate_argnums tuple -> observed jit
         self._telemetry = _telemetry
         self._uses_rng = uses_rng
         # wrap as a registered-op-shaped object so registry.invoke records it
@@ -124,24 +126,43 @@ class CachedOp:
             target._set_data(new._data)
         return main[0] if self._n_main == 1 else main
 
-    def lower(self, *example_inputs):
+    def lower(self, *example_inputs, donate=()):
         """AOT-lower the program at the example signature (jax Lowered).
 
         The compiled program's leading argument for RNG graphs is the
         per-call PRNG key (see __init__); one is synthesized so lowering
         matches the program's true arity. Lowering traces the executor, so
         the recompile watchdog sees it like any jit cache miss.
+
+        ``donate``: indices into ``example_inputs`` whose buffers the
+        compiled program may reuse for its outputs (``jax.jit``
+        donate_argnums — the serve/decode KV-cache update contract:
+        cache in, cache out, no second residency). Indices are in
+        example-input space; the RNG-key offset is applied internally.
         """
         datas = [getattr(x, "_data", x) for x in example_inputs]
         if self._uses_rng:
             datas.insert(0, jax.random.PRNGKey(0))
-        return self._jitted.lower(*datas)
+        if not donate:
+            return self._jitted.lower(*datas)
+        off = 1 if self._uses_rng else 0
+        argnums = tuple(sorted(int(i) + off for i in donate))
+        jitted = self._donated_jits.get(argnums)
+        if jitted is None:
+            from .ops.registry import _observe_compiles
+
+            jitted = jax.jit(
+                _observe_compiles(self._raw_fn,
+                                  f"cached_op:{self._name}", None),
+                donate_argnums=argnums)
+            self._donated_jits[argnums] = jitted
+        return jitted.lower(*datas)
 
     def lower_hlo(self, *example_inputs):
         """Return the StableHLO text for given example inputs (debugging)."""
         return self.lower(*example_inputs).as_text()
 
-    def aot_compile(self, *example_inputs):
+    def aot_compile(self, *example_inputs, donate=()):
         """Ahead-of-time compile at the example signature; returns the
         executable (jax Compiled).
 
@@ -151,9 +172,12 @@ class CachedOp:
         The executable rejects any other input signature — pad to the
         bucket before calling. With the persistent compilation cache on
         (``context.enable_compilation_cache``), the XLA compile inside is
-        a disk hit on every process after the first.
+        a disk hit on every process after the first. ``donate`` marks
+        example-input indices whose buffers the program may consume
+        (see ``lower``); callers must rebind those arrays to the
+        program's outputs after every call.
         """
-        return self.lower(*example_inputs).compile()
+        return self.lower(*example_inputs, donate=donate).compile()
 
 
 def trace(fn, inputs, params=(), transform=None):

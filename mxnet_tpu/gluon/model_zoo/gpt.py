@@ -6,12 +6,17 @@ src/operator/contrib/transformer.cc) — rebuilt as a pre-LN causal decoder,
 the architecture of GPT-2. TPU design notes:
 
 - attention runs through the causal flash-attention path
-  (ops/pallas_kernels.py) — O(T) memory, MXU-tiled;
+  (ops/pallas_kernels.py) — O(T) memory, MXU-tiled; padded batches ride
+  the same fused path via segment ids (``valid_length``);
 - the whole forward is one jit under hybridize: static shapes, no
   KV-cache branching in the compiled graph;
-- ``generate`` feeds a fixed-width window (static shape ⇒ one compiled
-  program serves every step — the TPU answer to the reference's
-  dynamic-length incremental decode).
+- incremental decode is a SEPARATE pair of fixed-shape paths
+  (``forward_prefill`` / ``forward_decode``) over a preallocated
+  ``[slots, layers, heads, max_len, head_dim]`` KV cache — the graphs the
+  continuous-batching engine (serve/decode) compiles ahead of time;
+- ``generate`` routes through the cached incremental path by default
+  (O(T) per token); the legacy fixed-width rolling-window re-forward
+  (O(T²) work) survives as the ``use_cache=False`` fallback.
 """
 from __future__ import annotations
 
@@ -52,15 +57,16 @@ class DecoderLayer(HybridBlock):
                               weight_initializer=init_mod.Normal(0.02),
                               in_units=hidden_size)
 
-    def forward(self, x):
+    def _qkv(self, x):
         h = self.ln_1(x)
         qkv = self.attn_qkv(h)
         units = qkv.shape[-1] // 3
         q = npx.slice_axis(qkv, axis=-1, begin=0, end=units)
         k = npx.slice_axis(qkv, axis=-1, begin=units, end=2 * units)
         v = npx.slice_axis(qkv, axis=-1, begin=2 * units, end=3 * units)
-        attn = npx.multihead_attention(q, k, v, num_heads=self._num_heads,
-                                       causal=True)
+        return q, k, v
+
+    def _post_attention(self, x, attn):
         attn = self.attn_proj(attn)
         if self._dropout:
             attn = npx.dropout(attn, p=self._dropout)
@@ -70,6 +76,46 @@ class DecoderLayer(HybridBlock):
         if self._dropout:
             ffn = npx.dropout(ffn, p=self._dropout)
         return x + ffn
+
+    def forward(self, x, mask=None):
+        """``mask``: optional (B, 1, 1, T) key-padding mask (1 = attend).
+        Combined with the causal mask on the fused flash path — without it
+        pad keys are attended like real tokens."""
+        out, _, _ = self.forward_prefill(x, mask)
+        return out
+
+    def forward_prefill(self, x, mask=None):
+        """Full-sequence forward that also returns this layer's k/v
+        (B, T, units) for KV-cache seeding. Runs the exact compute of
+        ``forward`` — prefill and the plain forward cannot drift."""
+        q, k, v = self._qkv(x)
+        attn = npx.multihead_attention(q, k, v, mask=mask,
+                                       num_heads=self._num_heads,
+                                       causal=True)
+        return self._post_attention(x, attn), k, v
+
+    def forward_decode(self, x, k_cache, v_cache, write_mask, kv_mask):
+        """One-token incremental step against this layer's cache.
+
+        x : (B, 1, units) current-token hidden state.
+        k_cache / v_cache : (B, max_len, units) — the slot cache in
+            flat (pre-head-split) layout.
+        write_mask : (B, max_len, 1) bool, True exactly at each row's
+            write position — the new k/v lands there.
+        kv_mask : (B, 1, 1, max_len) bool marking readable cache entries
+            (positions <= the write position), so stale/unwritten tail
+            entries never leak into attention.
+        Returns (out, k_cache', v_cache').
+        """
+        from ... import numpy as np
+
+        q, k, v = self._qkv(x)
+        k_cache = np.where(write_mask, k, k_cache)
+        v_cache = np.where(write_mask, v, v_cache)
+        attn = npx.multihead_attention(q, k_cache, v_cache, mask=kv_mask,
+                                       num_heads=self._num_heads,
+                                       causal=False)
+        return self._post_attention(x, attn), k_cache, v_cache
 
 
 class GPTModel(HybridBlock):
@@ -83,6 +129,10 @@ class GPTModel(HybridBlock):
         self.vocab_size = vocab_size
         self.max_length = max_length
         self._tie = tie_weights
+        self._units = units
+        self._num_heads = num_heads
+        self._num_layers = num_layers
+        self._dtype = dtype
         self.tok_embed = nn.Embedding(vocab_size, units, dtype=dtype)
         self.pos_embed = nn.Embedding(max_length, units, dtype=dtype)
         self.blocks = nn.HybridSequential()
@@ -96,43 +146,212 @@ class GPTModel(HybridBlock):
                                     use_bias=False, dtype=dtype,
                                     in_units=units)
 
-    def forward(self, tokens):
+    # -- shared pieces ------------------------------------------------------
+    def _lm_logits(self, x):
         from ... import numpy as np
 
-        B, T = tokens.shape
-        pos = np.arange(T, dtype="int32").reshape(1, T)
-        x = self.tok_embed(tokens) + self.pos_embed(pos)
-        if self._dropout:
-            x = npx.dropout(x, p=self._dropout)
-        for blk in self.blocks:
-            x = blk(x)
-        x = self.ln_f(x)
         if self._tie:
             # weight tying (Press & Wolf): logits = x · E^T
             return np.matmul(x, self.tok_embed.weight.data().T)
         return self.lm_head(x)
 
-    def generate(self, prompt, max_new_tokens=20, temperature=0.0,
-                 window=None):
-        """Greedy / temperature sampling with a fixed-width rolling window
-        so the compiled forward is reused for every step."""
+    def _pad_mask(self, valid_length, seq_len):
+        """(B, 1, 1, T) key-padding mask for right-padded batches: True for
+        positions < valid_length. Rides the fused flash path (segment ids)
+        when combined with causal attention."""
+        from ... import numpy as np
+
+        ar = np.arange(seq_len, dtype="int32").reshape(1, seq_len)
+        valid = valid_length.astype("int32").reshape(-1, 1)
+        return (ar < valid).reshape(-1, 1, 1, seq_len)
+
+    def _split_heads(self, x):
+        """(B, T, units) -> (B, heads, T, head_dim) — the KV-cache layout."""
+        from ... import numpy as np
+
+        T = x.shape[1]
+        d = self._units // self._num_heads
+        return np.transpose(
+            np.reshape(x, (-1, T, self._num_heads, d)), (0, 2, 1, 3))
+
+    def _merge_heads(self, x):
+        """(B, heads, T, head_dim) -> (B, T, units)."""
+        from ... import numpy as np
+
+        T = x.shape[2]
+        return np.reshape(np.transpose(x, (0, 2, 1, 3)),
+                          (-1, T, self._units))
+
+    def _embed(self, tokens, pos):
+        x = self.tok_embed(tokens) + self.pos_embed(pos)
+        if self._dropout:
+            x = npx.dropout(x, p=self._dropout)
+        return x
+
+    # -- full-sequence forward ----------------------------------------------
+    def forward(self, tokens, valid_length=None):
+        """Causal LM forward. ``valid_length`` (B,) marks right-padded rows:
+        pad keys (positions >= valid_length) are masked out of attention.
+        Without it every position is treated as real — callers padding
+        their batches must pass it or pad tokens leak into the context."""
+        from ... import numpy as np
+
+        B, T = tokens.shape
+        pos = np.arange(T, dtype="int32").reshape(1, T)
+        x = self._embed(tokens, pos)
+        mask = None if valid_length is None \
+            else self._pad_mask(valid_length, T)
+        for blk in self.blocks:
+            x = blk(x, mask) if mask is not None else blk(x)
+        x = self.ln_f(x)
+        return self._lm_logits(x)
+
+    # -- incremental decode (KV cache) --------------------------------------
+    def init_cache(self, batch, max_len):
+        """Preallocated KV cache pair, each
+        [batch(slots), layers, heads, max_len, head_dim]."""
+        from ... import numpy as np
+
+        if max_len > self.max_length:
+            raise MXNetError(
+                f"cache max_len {max_len} exceeds the position table "
+                f"max_length={self.max_length}")
+        d = self._units // self._num_heads
+        shape = (batch, self._num_layers, self._num_heads, max_len, d)
+        return (np.zeros(shape, dtype=self._dtype),
+                np.zeros(shape, dtype=self._dtype))
+
+    def forward_prefill(self, tokens, valid_length):
+        """Process whole (right-padded) prompts once and seed a KV cache.
+
+        tokens : (B, T) int32, right-padded; valid_length : (B,) int32.
+        Returns (last_logits (B, V) — logits at each row's final valid
+        position, k (B, layers, heads, T, head_dim), v (same)). K/V rows
+        past valid_length hold garbage the decode masks never read.
+        """
+        from ... import numpy as np
+
+        B, T = tokens.shape
+        pos = np.arange(T, dtype="int32").reshape(1, T)
+        x = self._embed(tokens, pos)
+        mask = self._pad_mask(valid_length, T)
+        ks, vs = [], []
+        for blk in self.blocks:
+            x, k, v = blk.forward_prefill(x, mask)
+            ks.append(self._split_heads(k))
+            vs.append(self._split_heads(v))
+        x = self.ln_f(x)
+        logits = self._lm_logits(x)                       # (B, T, V)
+        onehot = np.one_hot(valid_length.astype("int32") - 1, T,
+                            dtype=str(logits.dtype))      # (B, T)
+        last = np.einsum("btv,bt->bv", logits, onehot)
+        return last, np.stack(ks, axis=1), np.stack(vs, axis=1)
+
+    def forward_decode(self, tokens, positions, k_cache, v_cache):
+        """One decode tick: one new token per cache row.
+
+        tokens : (S,) int32 — each row's previous token.
+        positions : (S,) int32 — each row's write position (= current
+            length); the new k/v lands there and attention reads
+            positions <= it.
+        k_cache / v_cache : [S, layers, heads, max_len, head_dim].
+        Returns (logits (S, V), k_cache', v_cache'). Fixed shapes — the
+        decode engine compiles this ONCE and replays it every tick.
+        """
+        from ... import numpy as np
+
+        L = k_cache.shape[3]
+        pos2 = positions.astype("int32").reshape(-1, 1)
+        x = self._embed(tokens.reshape(-1, 1),
+                        np.minimum(pos2, self.max_length - 1))
+        ar = np.arange(L, dtype="int32").reshape(1, L)
+        write_mask = (ar == pos2).reshape(-1, L, 1)
+        kv_mask = (ar <= pos2).reshape(-1, 1, 1, L)
+        nk, nv = [], []
+        for i, blk in enumerate(self.blocks):
+            kc = self._merge_heads(np.squeeze(
+                npx.slice_axis(k_cache, axis=1, begin=i, end=i + 1), axis=1))
+            vc = self._merge_heads(np.squeeze(
+                npx.slice_axis(v_cache, axis=1, begin=i, end=i + 1), axis=1))
+            x, kc, vc = blk.forward_decode(x, kc, vc, write_mask, kv_mask)
+            nk.append(self._split_heads(kc))
+            nv.append(self._split_heads(vc))
+        x = self.ln_f(x)
+        logits = self._lm_logits(x)                       # (S, 1, V)
+        return (np.squeeze(logits, axis=1),
+                np.stack(nk, axis=1), np.stack(nv, axis=1))
+
+    # -- generation ----------------------------------------------------------
+    def _sample(self, logits, temperature):
         from ... import numpy as np
         from ... import random as rnd
 
+        if temperature > 0:
+            probs = npx.softmax(logits / temperature, axis=-1)
+            return int(rnd.categorical(np.log(
+                np.maximum(probs, 1e-20))).asnumpy())
+        return int(logits.asnumpy().argmax())
+
+    def generate(self, prompt, max_new_tokens=20, temperature=0.0,
+                 window=None, use_cache=None):
+        """Greedy / temperature sampling.
+
+        ``use_cache=None`` (auto) routes through the incremental KV-cache
+        path whenever the full sequence fits ``max_length`` — O(T) work
+        per token, exact positions, one fixed-shape step program. The
+        legacy fixed-width rolling-window loop (``use_cache=False``, or
+        sequences past max_length) re-runs the whole window per token;
+        its windows are right-padded and masked (``valid_length``), so
+        pad tokens no longer leak into attention.
+        """
+        from ... import numpy as np
+
+        if hasattr(prompt, "asnumpy"):
+            prompt = prompt.asnumpy()
+        toks = [int(t) for t in onp.asarray(prompt).ravel()]
+        if max_new_tokens < 1:
+            return toks
+        total = len(toks) + max_new_tokens
+        if use_cache is None:
+            use_cache = total <= self.max_length
+        if use_cache:
+            if total > self.max_length:
+                raise MXNetError(
+                    f"use_cache generation needs prompt+new <= max_length="
+                    f"{self.max_length}, got {total} — pass "
+                    "use_cache=False for the rolling-window fallback")
+            return self._generate_cached(toks, max_new_tokens, temperature)
         window = window or min(self.max_length, 64)
-        toks = list(onp.asarray(prompt.asnumpy(), dtype="int64").ravel())
         for _ in range(max_new_tokens):
             ctx_toks = toks[-window:]
-            pad = window - len(ctx_toks)
-            inp = onp.asarray([[0] * pad + ctx_toks], dtype="int32")
-            logits = self(np.array(inp))[0, -1]
-            if temperature > 0:
-                probs = npx.softmax(logits / temperature, axis=-1)
-                nxt = int(rnd.categorical(np.log(
-                    np.maximum(probs, 1e-20))).asnumpy())
-            else:
-                nxt = int(logits.asnumpy().argmax())
-            toks.append(nxt)
+            L = len(ctx_toks)
+            inp = onp.zeros((1, window), dtype="int32")
+            inp[0, :L] = ctx_toks
+            logits = self(np.array(inp),
+                          np.array(onp.asarray([L], "int32")))[0, L - 1]
+            toks.append(self._sample(logits, temperature))
+        return toks
+
+    def _generate_cached(self, toks, max_new_tokens, temperature):
+        """Single-request degenerate case of the serve/decode engine:
+        prefill once, then replay the fixed-shape decode step."""
+        from ... import numpy as np
+
+        T0 = len(toks)
+        total = T0 + max_new_tokens
+        last, k, v = self.forward_prefill(
+            np.array(onp.asarray([toks], "int32")),
+            np.array(onp.asarray([T0], "int32")))
+        pad = total - T0
+        if pad:
+            widths = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
+            k, v = np.pad(k, widths), np.pad(v, widths)
+        toks.append(self._sample(last[0], temperature))
+        for i in range(1, max_new_tokens):
+            logits, k, v = self.forward_decode(
+                np.array(onp.asarray([toks[-1]], "int32")),
+                np.array(onp.asarray([T0 + i - 1], "int32")), k, v)
+            toks.append(self._sample(logits[0], temperature))
         return toks
 
 

@@ -1,0 +1,25 @@
+"""mxnet_tpu.serve.decode — continuous-batching autoregressive decoding.
+
+The LLM leg of the serving story (ISSUE 7): a slot-paged KV cache
+(:mod:`cache`), exactly two AOT-compiled program families — bucketed
+``prefill`` and fixed-shape ``decode_tick`` (:mod:`programs`) — and a
+continuous-batching scheduler with streaming token futures, deadlines,
+and load shedding (:mod:`engine`).
+
+Quick start::
+
+    eng = serve.decode.DecodeEngine(model, num_slots=8)
+    eng.warmup("gpt.decode.manifest.json")   # compile everything up front
+    stream = eng.submit(prompt_ids, max_new_tokens=32, deadline_ms=500)
+    for tok in stream:                       # tokens as they are decoded
+        ...
+    stream.result()                          # or block for the full list
+
+See docs/DESIGN.md "Continuous-batching decode".
+"""
+from .cache import KVCache, SlotAllocator
+from .engine import DecodeEngine, DecodeStream, ShedError
+from .programs import DecodePrograms, load_decode_manifest
+
+__all__ = ["DecodeEngine", "DecodeStream", "ShedError", "KVCache",
+           "SlotAllocator", "DecodePrograms", "load_decode_manifest"]

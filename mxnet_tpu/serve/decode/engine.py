@@ -1,0 +1,587 @@
+"""DecodeEngine: continuous batching over the two decode program families.
+
+The host never computes on tensors — each scheduler tick it only feeds
+operands (token ids, positions, slot routing vectors) to one of the two
+AOT executables and applies bookkeeping to the results:
+
+    tick:  expire deadlines -> admit pending into free slots (prefill
+           program, bucketed batch x length) -> one decode_tick for ALL
+           slots -> emit tokens / retire finished requests
+
+``submit`` is thread-safe and returns a :class:`DecodeStream` — a
+streaming token future: per-token callbacks fire from the scheduler
+thread, ``result()`` blocks for the full generation, iteration yields
+tokens as they land. Load past the queue-depth budget (or past its
+deadline before ever reaching a slot) is SHED with :class:`ShedError`;
+a request whose deadline expires mid-generation is EVICTED — its stream
+finishes with the tokens produced so far and ``expired=True``.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import deque
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...telemetry.registry import Histogram
+from ..bucketing import pick_bucket
+from .cache import KVCache
+from .programs import DecodePrograms
+
+__all__ = ["DecodeEngine", "DecodeStream", "ShedError"]
+
+_STOP = object()
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class ShedError(MXNetError):
+    """The engine refused (or dropped) a request to protect latency."""
+
+
+class DecodeStream:
+    """Streaming token future for one submitted prompt.
+
+    - ``on_token(token_id)`` fires from the scheduler thread per token;
+    - iteration yields generated token ids as they arrive;
+    - ``result(timeout)`` blocks until the stream finishes and returns
+      the full generated-token list (raises if the request was shed).
+
+    ``expired`` marks a deadline eviction (partial output), ``truncated``
+    marks a generation clipped by KV-cache capacity.
+    """
+
+    def __init__(self, prompt, max_new_tokens, deadline, on_token=None):
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline      # absolute perf_counter() time or None
+        self.tokens = []
+        self.expired = False
+        self.truncated = False
+        self.t_submit = time.perf_counter()
+        self._t_last = None           # engine: last emit time (TTFT/TPOT)
+        self._on_token = on_token
+        self._cond = threading.Condition()
+        self._done = False
+        self._error = None
+
+    # -- engine side -------------------------------------------------------
+    def _emit(self, tok):
+        with self._cond:
+            self.tokens.append(tok)
+            self._cond.notify_all()
+        if self._on_token is not None:
+            self._on_token(tok)
+
+    def _finish(self, error=None):
+        with self._cond:
+            self._error = error
+            self._done = True
+            self._cond.notify_all()
+
+    # -- client side -------------------------------------------------------
+    @property
+    def done(self):
+        return self._done
+
+    def result(self, timeout=None):
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done, timeout):
+                raise MXNetError("DecodeStream.result timed out")
+            if self._error is not None:
+                raise self._error
+            return list(self.tokens)
+
+    def __iter__(self):
+        i = 0
+        while True:
+            with self._cond:
+                self._cond.wait_for(
+                    lambda: self._done or len(self.tokens) > i)
+                if i < len(self.tokens):
+                    tok = self.tokens[i]
+                else:
+                    if self._error is not None:
+                        raise self._error
+                    return
+            i += 1
+            yield tok
+
+
+class DecodeEngine:
+    """Continuous-batching autoregressive decoding for a GPT-style model.
+
+    Parameters
+    ----------
+    model : GPTModel-like block, optional
+        Must expose ``forward_prefill`` / ``forward_decode`` /
+        ``init_cache``. May be omitted when ``programs`` (e.g. from
+        ``DecodeEngine.from_export``) supplies traced graphs.
+    num_slots : int
+        Concurrent sequences per decode tick (the fixed decode program
+        shape). Default: ``MXTPU_DECODE_SLOTS`` (8).
+    max_len : int
+        KV-cache positions per slot. Default: ``model.max_length``.
+    max_prompt_len : int
+        Longest admissible prompt; tops the prefill length ladder.
+    prefill_batch : int
+        Largest prefill batch; tops the prefill batch ladder.
+    max_wait_us : int
+        Idle-coalesce window before the first prefill of a burst.
+        Default: ``MXTPU_DECODE_MAX_WAIT_US`` (2000).
+    deadline_ms : int
+        Default per-request deadline; 0 disables. Default:
+        ``MXTPU_DECODE_DEADLINE_MS`` (0).
+    max_queue : int
+        Queue-depth shed threshold (pending, i.e. not-yet-slotted,
+        requests). Default ``max(4 * num_slots, 16)``.
+    cache_dir : str | None | False
+        Persistent XLA compile cache dir (False disables), as Predictor.
+    manifest : str | dict, optional
+        Warmup manifest from a previous process: adopts its geometry and
+        precompiles everything immediately (disk-hit compiles).
+    """
+
+    def __init__(self, model=None, *, num_slots=None, max_len=None,
+                 max_prompt_len=None, prefill_batch=4, max_wait_us=None,
+                 deadline_ms=None, max_queue=None, cache_dir=None,
+                 manifest=None, programs=None):
+        from ... import telemetry as _tm
+        from ...context import enable_compilation_cache
+
+        self._tm = _tm
+        if cache_dir is not False:
+            self.cache_dir = enable_compilation_cache(cache_dir)
+        else:
+            self.cache_dir = None
+
+        manifest_dict = None
+        if manifest is not None:
+            from .programs import load_decode_manifest
+
+            manifest_dict = load_decode_manifest(manifest) \
+                if isinstance(manifest, str) else dict(manifest)
+            num_slots = int(manifest_dict["num_slots"])
+            max_len = int(manifest_dict["max_len"])
+            max_prompt_len = int(manifest_dict["max_prompt_len"])
+            prefill_batch = int(manifest_dict["prefill_batch"])
+
+        if programs is not None:
+            self.programs = programs
+        else:
+            if model is None:
+                raise MXNetError(
+                    "DecodeEngine needs a model (or programs from an "
+                    "export)")
+            num_slots = int(num_slots or _env_int("MXTPU_DECODE_SLOTS", 8))
+            max_len = int(max_len or model.max_length)
+            self.programs = DecodePrograms(
+                model, num_slots=num_slots, max_len=max_len,
+                prefill_batch=prefill_batch,
+                max_prompt_len=max_prompt_len)
+        self.num_slots = self.programs.num_slots
+        self.max_len = self.programs.max_len
+        self.max_prompt_len = self.programs.max_prompt_len
+        self.prefill_batch = self.programs.prefill_batch
+
+        self.max_wait_us = int(max_wait_us if max_wait_us is not None
+                               else _env_int("MXTPU_DECODE_MAX_WAIT_US",
+                                             2000))
+        dl = deadline_ms if deadline_ms is not None \
+            else _env_int("MXTPU_DECODE_DEADLINE_MS", 0)
+        self.deadline_ms = int(dl)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else max(4 * self.num_slots, 16))
+
+        # -- device + scheduler state (owned by the worker thread) ---------
+        self._cache = KVCache(self.programs.cache_shape,
+                              self.programs.cache_dtype)
+        self._slot_req = {}   # sid -> DecodeStream
+        self._last_tok = onp.zeros(self.num_slots, dtype="int32")
+
+        self._q = queue.SimpleQueue()
+        self._worker = None
+        self._worker_lock = threading.Lock()
+        self._closed = False
+
+        # -- accounting (always on: these ARE the serving stats) -----------
+        self._stats_lock = threading.Lock()
+        self._n_requests = 0
+        self._n_completed = 0
+        self._n_shed = 0
+        self._n_evicted = 0
+        self._n_tokens = 0
+        self._n_ticks = 0
+        self._n_prefills = 0
+        self._occupancy_sum = 0.0
+        self._pending_count = 0
+        self._ttft_ms = Histogram("serve.ttft_ms")
+        self._tpot_ms = Histogram("serve.tpot_ms")
+
+        if manifest_dict is not None:
+            self.warmup()
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, manifest_path=None):
+        """Precompile decode_tick + every (batch, len) prefill bucket;
+        optionally write a manifest. After this the scheduler compiles
+        nothing, whatever traffic arrives (asserted via the jit compile
+        counter in tests/test_decode.py). Returns the manifest dict."""
+        import json
+
+        self.programs.warmup()
+        manifest = self.programs.manifest_dict(cache_dir=self.cache_dir)
+        if manifest_path:
+            tmp = manifest_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(manifest, fh, indent=1)
+            os.replace(tmp, manifest_path)
+        return manifest
+
+    def export(self, prefix):
+        """Serialize the traced graphs + params + manifest (see
+        ``DecodePrograms.export``); returns the manifest path."""
+        return self.programs.export(prefix)
+
+    @classmethod
+    def from_export(cls, prefix, **kwargs):
+        """Rebuild a serving engine from ``export`` artifacts — no model
+        class needed; with the persistent compile cache on, no XLA
+        compiles either. Extra kwargs pass through (scheduler knobs)."""
+        progs = DecodePrograms.from_export(prefix)
+        eng = cls(programs=progs, **kwargs)
+        eng.warmup()
+        return eng
+
+    # ------------------------------------------------------------- submit
+    def submit(self, prompt, max_new_tokens=20, deadline_ms=None,
+               on_token=None):
+        """Enqueue one prompt; returns a :class:`DecodeStream`.
+
+        Raises :class:`ShedError` immediately when the pending queue is
+        at budget. ``deadline_ms`` (engine default when None, 0 = none)
+        bounds TOTAL time: a request that can't start in time is shed,
+        one that can't finish is evicted with partial output.
+        """
+        if self._closed:
+            raise MXNetError("DecodeEngine is closed")
+        toks = self._normalize_prompt(prompt)
+        if max_new_tokens < 1:
+            raise MXNetError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        with self._stats_lock:
+            self._n_requests += 1
+            over = self._pending_count >= self.max_queue
+            if not over:
+                self._pending_count += 1
+        if self._tm.ON:
+            self._tm.REGISTRY.counter("serve.requests").inc()
+        if over:
+            self._shed_one()
+            raise ShedError(
+                f"decode queue at budget ({self.max_queue} pending); "
+                "retry later or raise max_queue")
+        dl_ms = self.deadline_ms if deadline_ms is None else int(deadline_ms)
+        deadline = (time.perf_counter() + dl_ms * 1e-3) if dl_ms > 0 else None
+        # clip generation to cache capacity: the last token's KV lands at
+        # position len(prompt) + max_new - 2, which must stay < max_len
+        budget = self.max_len - len(toks) + 1
+        stream = DecodeStream(toks, min(int(max_new_tokens), budget),
+                              deadline, on_token)
+        if stream.max_new_tokens < max_new_tokens:
+            stream.truncated = True
+        self._start_worker()
+        self._q.put(stream)
+        return stream
+
+    def _normalize_prompt(self, prompt):
+        from ...ndarray.ndarray import NDArray
+
+        if isinstance(prompt, NDArray):
+            prompt = onp.asarray(prompt._data)
+        toks = [int(t) for t in onp.asarray(prompt).reshape(-1)]
+        if not toks:
+            raise MXNetError("cannot decode from an empty prompt")
+        if len(toks) > self.max_prompt_len:
+            raise MXNetError(
+                f"prompt length {len(toks)} exceeds max_prompt_len "
+                f"{self.max_prompt_len}")
+        return toks
+
+    # ---------------------------------------------------------- scheduler
+    def _start_worker(self):
+        if self._worker is not None:
+            return
+        with self._worker_lock:
+            if self._worker is None:
+                t = threading.Thread(target=self._loop,
+                                     name="mxtpu-decode-engine",
+                                     daemon=True)
+                self._worker = t
+                t.start()
+
+    def _loop(self):
+        pending = deque()
+        try:
+            while not self._gather(pending):
+                self._expire(pending)
+                self._admit(pending)
+                if self._slot_req:
+                    self._tick()
+        finally:
+            self._drain(pending)
+
+    def _gather(self, pending):
+        """Pull new requests off the queue. Blocks when fully idle;
+        otherwise drains without waiting (the decode tick itself is the
+        coalescing window once slots are live). Returns True on STOP."""
+        idle = not self._slot_req and not pending
+        try:
+            item = self._q.get() if idle else self._q.get_nowait()
+        except queue.Empty:
+            return False
+        if item is _STOP:
+            return True
+        pending.append(item)
+        if idle and self.max_wait_us > 0:
+            # a burst is likely arriving together: hold the first prefill
+            # open briefly so it batches instead of running B=1
+            deadline = time.perf_counter() + self.max_wait_us * 1e-6
+            while len(pending) < self.prefill_batch:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if item is _STOP:
+                    return True
+                pending.append(item)
+        else:
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _STOP:
+                    return True
+                pending.append(item)
+        return False
+
+    def _expire(self, pending):
+        now = time.perf_counter()
+        for stream in [s for s in pending
+                       if s.deadline is not None and now > s.deadline]:
+            pending.remove(stream)
+            self._shed_one(admitted=True)
+            stream._finish(ShedError(
+                "deadline expired before the request reached a slot"))
+        for sid in [s for s, st in self._slot_req.items()
+                    if st.deadline is not None and now > st.deadline]:
+            self._retire(sid, expired=True)
+
+    def _admit(self, pending):
+        while pending and self._cache.slots.free_count:
+            n = min(len(pending), self._cache.slots.free_count,
+                    self.prefill_batch)
+            self._prefill([pending.popleft() for _ in range(n)])
+
+    def _prefill(self, group):
+        import jax
+
+        cache = self._cache
+        slots = [cache.slots.alloc() for _ in group]
+        B = pick_bucket(len(group), self.programs.batch_ladder)
+        T = pick_bucket(max(len(s.prompt) for s in group),
+                        self.programs.len_ladder)
+        tokens = onp.zeros((B, T), dtype="int32")
+        valid = onp.ones((B,), dtype="int32")
+        inv = onp.zeros((self.num_slots,), dtype="int32")
+        hit = onp.zeros((self.num_slots,), dtype=bool)
+        for i, (stream, sid) in enumerate(zip(group, slots)):
+            tokens[i, :len(stream.prompt)] = stream.prompt
+            valid[i] = len(stream.prompt)
+            inv[sid] = i
+            hit[sid] = True
+        key = ("prefill", B, T)
+        self.programs.ensure("prefill", batch=B, length=T)
+        outs = self.programs.run(key, [
+            jax.device_put(tokens), jax.device_put(valid),
+            jax.device_put(inv), jax.device_put(hit), cache.k, cache.v])
+        cache.rebind(outs[1], outs[2])
+        first = onp.asarray(outs[0])      # device sync: the TTFT tokens
+        tm = self._tm
+        if tm.ON:
+            tm.record_dispatch()
+        with self._stats_lock:
+            self._n_prefills += 1
+            self._pending_count -= len(group)
+        for i, (stream, sid) in enumerate(zip(group, slots)):
+            cache.lengths[sid] = len(stream.prompt)
+            self._slot_req[sid] = stream
+            tok = int(first[i])
+            self._last_tok[sid] = tok
+            self._emit_token(stream, tok)
+            if len(stream.tokens) >= stream.max_new_tokens:
+                self._retire(sid)
+        self._set_slot_gauge()
+
+    def _tick(self):
+        import jax
+
+        cache = self._cache
+        key = ("decode",)
+        self.programs.ensure("decode")
+        outs = self.programs.run(key, [
+            jax.device_put(self._last_tok),
+            jax.device_put(cache.lengths), cache.k, cache.v])
+        cache.rebind(outs[1], outs[2])
+        nxt = onp.asarray(outs[0])        # device sync: this tick's tokens
+        tm = self._tm
+        if tm.ON:
+            tm.record_dispatch()
+        occ = cache.occupancy()
+        with self._stats_lock:
+            self._n_ticks += 1
+            self._occupancy_sum += occ
+        for sid in sorted(self._slot_req):
+            stream = self._slot_req[sid]
+            cache.lengths[sid] += 1
+            tok = int(nxt[sid])
+            self._last_tok[sid] = tok
+            self._emit_token(stream, tok)
+            if len(stream.tokens) >= stream.max_new_tokens:
+                self._retire(sid)
+            elif cache.lengths[sid] >= cache.max_len:
+                stream.truncated = True
+                self._retire(sid)
+
+    def _emit_token(self, stream, tok):
+        now = time.perf_counter()
+        tm = self._tm
+        if stream._t_last is None:
+            ms = (now - stream.t_submit) * 1e3
+            self._ttft_ms.record(ms)
+            if tm.ON:
+                tm.REGISTRY.histogram("serve.ttft_ms").record(ms)
+        else:
+            ms = (now - stream._t_last) * 1e3
+            self._tpot_ms.record(ms)
+            if tm.ON:
+                tm.REGISTRY.histogram("serve.tpot_ms").record(ms)
+        stream._t_last = now
+        with self._stats_lock:
+            self._n_tokens += 1
+        if tm.ON:
+            tm.REGISTRY.counter("serve.tokens_total").inc()
+        stream._emit(tok)
+
+    def _retire(self, sid, expired=False):
+        cache = self._cache
+        stream = self._slot_req.pop(sid)
+        cache.slots.free(sid)
+        cache.lengths[sid] = 0
+        self._last_tok[sid] = 0
+        stream.expired = expired
+        stream._finish()
+        with self._stats_lock:
+            self._n_completed += 1
+            if expired:
+                self._n_evicted += 1
+        if expired and self._tm.ON:
+            self._tm.REGISTRY.counter("serve.evict_total").inc()
+        self._set_slot_gauge()
+
+    def _shed_one(self, admitted=False):
+        with self._stats_lock:
+            self._n_shed += 1
+            if admitted:
+                self._pending_count -= 1
+        if self._tm.ON:
+            self._tm.REGISTRY.counter("serve.shed_total").inc()
+
+    def _set_slot_gauge(self):
+        if self._tm.ON:
+            self._tm.REGISTRY.gauge("serve.slots_live").set(
+                len(self._slot_req))
+
+    def _drain(self, pending):
+        err = MXNetError("DecodeEngine closed before completion")
+        for sid in list(self._slot_req):
+            stream = self._slot_req.pop(sid)
+            self._cache.slots.free(sid)
+            stream._finish(err)
+        for stream in pending:
+            self._shed_one(admitted=True)
+            stream._finish(err)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                self._shed_one(admitted=True)
+                item._finish(err)
+
+    # ----------------------------------------------------------- reporting
+    def stats(self):
+        """Engine accounting independent of the global telemetry gate."""
+        with self._stats_lock:
+            ticks = self._n_ticks
+            occ = self._occupancy_sum / ticks if ticks else 0.0
+            out = {
+                "requests": self._n_requests,
+                "completed": self._n_completed,
+                "shed": self._n_shed,
+                "evicted": self._n_evicted,
+                "tokens": self._n_tokens,
+                "ticks": ticks,
+                "prefills": self._n_prefills,
+                "pending": self._pending_count,
+            }
+        p50, p99 = self._ttft_ms.percentiles(50, 99)
+        out["ttft_ms_p50"], out["ttft_ms_p99"] = p50, p99
+        p50, p99 = self._tpot_ms.percentiles(50, 99)
+        out["tpot_ms_p50"], out["tpot_ms_p99"] = p50, p99
+        out["mean_slot_occupancy"] = occ
+        out["slots_live"] = len(self._slot_req)
+        out["num_slots"] = self.num_slots
+        out["cache_bytes"] = self._cache.nbytes
+        out["programs"] = sorted(
+            "|".join(str(k) for k in key)
+            for key in self.programs._programs)
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        """Stop the scheduler (idempotent). Live and queued streams
+        finish with an error; later ``submit`` raises."""
+        if self._closed:
+            return
+        self._closed = True
+        worker = self._worker
+        if worker is not None:
+            self._q.put(_STOP)
+            worker.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

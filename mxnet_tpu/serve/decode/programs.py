@@ -1,0 +1,339 @@
+"""The two AOT-compiled program families of the decode engine.
+
+Exactly two graph shapes exist (PyGraph's whole-iteration capture applied
+to decoding — the host only feeds operands):
+
+- ``prefill(bucket_batch, bucket_len)``: forward the whole right-padded
+  prompt batch once, argmax the logits at each row's last valid position
+  (the first generated token), and scatter the per-layer k/v into the
+  assigned cache slots (``inv_index``/``hit`` route batch rows to slot
+  rows in-program, so the donated cache is updated without a host-side
+  copy). One traced graph per length bucket, compiled per batch bucket —
+  the program set is O(log max_prompt_len · log prefill_batch).
+- ``decode_tick(num_slots)``: one token for EVERY slot against the full
+  cache — fixed shape, traced and compiled exactly once, so steady state
+  never recompiles regardless of which requests join or leave.
+
+Both families donate the cache pair (cache in, cache out — a single
+device residency; on backends without donation support XLA falls back to
+copying). ``export``/``from_export`` round-trip the traced graphs through
+Symbol JSON + a params npz, so a fresh process can serve without the
+model class — the SymbolBlock.imports analog for the decode engine.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import warnings
+
+import numpy as onp
+
+from ...base import MXNetError
+from ..bucketing import bucket_ladder, pick_bucket
+
+__all__ = ["DecodePrograms", "load_decode_manifest"]
+
+
+def load_decode_manifest(path):
+    with open(path) as fh:
+        m = json.load(fh)
+    if m.get("version") != 1 or m.get("kind") != "decode_engine":
+        raise MXNetError(
+            f"unsupported decode manifest in {path}: version="
+            f"{m.get('version')!r} kind={m.get('kind')!r}")
+    return m
+
+
+def _compile(cop, examples, donate):
+    """AOT-compile suppressing the backend's 'donation not implemented'
+    warning (CPU): the fallback is a copy, which is correct — the donation
+    request is for the TPU path."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message=".*donat.*",
+                                category=UserWarning)
+        return cop.aot_compile(*examples, donate=donate)
+
+
+class DecodePrograms:
+    """Trace + compile + (de)serialize the engine's program table.
+
+    Built either from a live model (``DecodePrograms(model, ...)``) or
+    from an export directory (``DecodePrograms.from_export(prefix)``).
+    """
+
+    # donated operand indices (example-input space)
+    _PREFILL_DONATE = (4, 5)   # (tokens, valid, inv_index, hit, kc, vc)
+    _DECODE_DONATE = (2, 3)    # (tokens, positions, kc, vc)
+
+    def __init__(self, model=None, *, num_slots, max_len, prefill_batch=4,
+                 max_prompt_len=None, min_prompt_bucket=8, _from_export=None):
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.prefill_batch = int(prefill_batch)
+        max_prompt_len = int(max_prompt_len or self.max_len)
+        if max_prompt_len > self.max_len:
+            raise MXNetError(
+                f"max_prompt_len {max_prompt_len} exceeds cache max_len "
+                f"{self.max_len}")
+        self.max_prompt_len = max_prompt_len
+        self.batch_ladder = bucket_ladder(self.prefill_batch)
+        self.len_ladder = bucket_ladder(
+            max_prompt_len, min_bucket=min(min_prompt_bucket,
+                                           max_prompt_len))
+        self._model = model
+        self._cops = {}         # "decode" | "prefill:<T>" -> CachedOp
+        self._graph_params = {}  # graph key -> ordered param names
+        self._params = {}       # name -> raw device array
+        self._programs = {}     # ("decode",) | ("prefill", B, T) -> Compiled
+        self._signatures = {}   # str key -> trace signature
+        self.cache_shape = None  # [S, layers, heads, max_len, head_dim]
+        self.cache_dtype = "float32"
+        if _from_export is not None:
+            self._load_export(_from_export)
+        else:
+            if model is None:
+                raise MXNetError("DecodePrograms needs a model or an export")
+            self._trace_all()
+
+    # ----------------------------------------------------------------- trace
+    def _collect_params(self):
+        return [(name, p.data())
+                for name, p in self._model.collect_params().items()
+                if p._data is not None]
+
+    def _trace_all(self):
+        from ... import autograd
+
+        params = self._collect_params()
+        self._params = {name: arr._data for name, arr in params}
+        names = [name for name, _ in params]
+        with autograd.pause():
+            self._cops["decode"] = self._trace_decode(params)
+            self._graph_params["decode"] = names
+            for T in self.len_ladder:
+                self._cops[f"prefill:{T}"] = self._trace_prefill(T, params)
+                self._graph_params[f"prefill:{T}"] = names
+
+    def _trace_decode(self, params):
+        from ... import numpy as np
+        from ...cached_op import trace
+
+        model = self._model
+        S = self.num_slots
+        tokens = np.zeros((S,), dtype="int32")
+        positions = np.zeros((S,), dtype="int32")
+        kc, vc = model.init_cache(S, self.max_len)
+        self.cache_shape = tuple(int(d) for d in kc.shape)
+        self.cache_dtype = str(kc.dtype)
+
+        def fn(t, p, k, v):
+            logits, k2, v2 = model.forward_decode(t, p, k, v)
+            nxt = np.argmax(logits, axis=-1).astype("int32")
+            return nxt, k2, v2
+
+        _, _, cop = trace(fn, [tokens, positions, kc, vc], params)
+        cop._name = "serve_decode_tick"
+        return cop
+
+    def _trace_prefill(self, T, params):
+        from ... import numpy as np
+        from ...cached_op import trace
+
+        model = self._model
+        S, B = self.num_slots, self.prefill_batch
+        tokens = np.zeros((B, T), dtype="int32")
+        valid = np.ones((B,), dtype="int32")
+        inv_index = np.zeros((S,), dtype="int32")
+        hit = np.zeros((S,), dtype="bool")
+        kc, vc = model.init_cache(S, self.max_len)
+        pad = self.max_len - T
+
+        def fn(tok, vl, inv, h, k_cache, v_cache):
+            last, k, v = model.forward_prefill(tok, vl)
+            first = np.argmax(last, axis=-1).astype("int32")
+            # route batch rows to their slots: gather-by-inv_index builds
+            # a slot-shaped view of the new k/v, `hit` picks which slot
+            # rows actually change — the rest keep the donated cache
+            sel_k = np.take(k, inv, axis=0, mode="clip")
+            sel_v = np.take(v, inv, axis=0, mode="clip")
+            if pad:
+                widths = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
+                sel_k, sel_v = np.pad(sel_k, widths), np.pad(sel_v, widths)
+            hm = h.reshape(-1, 1, 1, 1, 1)
+            return (first, np.where(hm, sel_k, k_cache),
+                    np.where(hm, sel_v, v_cache))
+
+        _, _, cop = trace(fn, [tokens, valid, inv_index, hit, kc, vc],
+                          params)
+        cop._name = f"serve_prefill_{T}"
+        return cop
+
+    # --------------------------------------------------------------- compile
+    def _zeros(self, shape, dtype):
+        import jax.numpy as jnp
+
+        return jnp.zeros(shape, dtype)
+
+    def ensure(self, kind, batch=None, length=None):
+        """Compile (memoized) and return one executable."""
+        if kind == "decode":
+            key = ("decode",)
+        else:
+            key = ("prefill", int(batch), int(length))
+        prog = self._programs.get(key)
+        if prog is not None:
+            return prog
+        from ...telemetry.watchdog import format_signature
+
+        kc = self._zeros(self.cache_shape, self.cache_dtype)
+        vc = self._zeros(self.cache_shape, self.cache_dtype)
+        S = self.num_slots
+        if kind == "decode":
+            cop = self._cops["decode"]
+            examples = [self._zeros((S,), "int32"),
+                        self._zeros((S,), "int32"), kc, vc]
+            donate = self._DECODE_DONATE
+        else:
+            cop = self._cops.get(f"prefill:{length}")
+            if cop is None:
+                raise MXNetError(
+                    f"no prefill graph for length bucket {length} "
+                    f"(ladder: {self.len_ladder})")
+            examples = [self._zeros((batch, length), "int32"),
+                        self._zeros((batch,), "int32"),
+                        self._zeros((S,), "int32"),
+                        self._zeros((S,), "bool"), kc, vc]
+            donate = self._PREFILL_DONATE
+        args = examples + [self._params[n]
+                           for n in self._graph_params[self._cop_key(key)]]
+        prog = _compile(cop, args, donate)
+        self._programs[key] = prog
+        self._signatures["|".join(str(k) for k in key)] = format_signature(
+            [getattr(x, "_data", x) for x in examples])
+        return prog
+
+    @staticmethod
+    def _cop_key(key):
+        return "decode" if key[0] == "decode" else f"prefill:{key[2]}"
+
+    def run(self, key, datas):
+        """Call a compiled program with raw device operands; appends the
+        param tail (and a PRNG key for rng graphs) in trace order."""
+        prog = self._programs[key]
+        cop = self._cops[self._cop_key(key)]
+        args = list(datas) + [self._params[n]
+                              for n in self._graph_params[self._cop_key(key)]]
+        if cop._uses_rng:
+            from ... import random as _rnd
+
+            args.insert(0, _rnd._next_key())
+        outs = prog(*args)
+        return outs if isinstance(outs, (tuple, list)) else (outs,)
+
+    def warmup(self):
+        """Compile the whole table: decode_tick + every (batch, len)
+        prefill bucket. After this, serving compiles nothing."""
+        self.ensure("decode")
+        for T in self.len_ladder:
+            for B in self.batch_ladder:
+                self.ensure("prefill", batch=B, length=T)
+
+    # ------------------------------------------------------------- manifests
+    def manifest_dict(self, cache_dir=None, graphs=None):
+        from ...context import _probe_env_signature
+
+        import jax
+
+        return {
+            "version": 1,
+            "kind": "decode_engine",
+            "env_signature": _probe_env_signature(),
+            "jax_version": getattr(jax, "__version__", "?"),
+            "num_slots": self.num_slots,
+            "max_len": self.max_len,
+            "prefill_batch": self.prefill_batch,
+            "max_prompt_len": self.max_prompt_len,
+            "batch_ladder": list(self.batch_ladder),
+            "len_ladder": list(self.len_ladder),
+            "cache_shape": list(self.cache_shape or ()),
+            "cache_dtype": self.cache_dtype,
+            "signatures": dict(sorted(self._signatures.items())),
+            "cache_dir": cache_dir,
+            "graphs": graphs,
+            "created_unix": time.time(),
+        }
+
+    # ---------------------------------------------------------------- export
+    def export(self, prefix):
+        """Write the traced graphs + params + manifest; returns the
+        manifest path. A fresh process rebuilds the full program table
+        from these files alone (``from_export``) — no model class needed,
+        and with the persistent compile cache on, no XLA compiles either.
+        """
+        graphs = {}
+        for key, cop in self._cops.items():
+            fname = f"{prefix}-{key.replace(':', '_')}-symbol.json"
+            cop.sym.save(fname)
+            graphs[key] = {"file": os.path.basename(fname),
+                           "n_data": 4 if key == "decode" else 6,
+                           "params": self._graph_params[key]}
+        onp.savez(f"{prefix}-params.npz",
+                  **{n: onp.asarray(a) for n, a in self._params.items()})
+        m = self.manifest_dict(graphs=graphs)
+        m["params_file"] = os.path.basename(f"{prefix}-params.npz")
+        mpath = f"{prefix}-decode.manifest.json"
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(m, fh, indent=1)
+        os.replace(tmp, mpath)
+        return mpath
+
+    @classmethod
+    def from_export(cls, prefix_or_manifest):
+        """Rebuild the program table from ``export`` artifacts."""
+        mpath = prefix_or_manifest
+        if not mpath.endswith(".json"):
+            mpath = f"{prefix_or_manifest}-decode.manifest.json"
+        m = load_decode_manifest(mpath)
+        self = cls(num_slots=m["num_slots"], max_len=m["max_len"],
+                   prefill_batch=m["prefill_batch"],
+                   max_prompt_len=m["max_prompt_len"],
+                   _from_export=(m, os.path.dirname(os.path.abspath(mpath))))
+        return self
+
+    def _load_export(self, export):
+        import jax.numpy as jnp
+
+        from ...cached_op import CachedOp
+        from ...symbol.symbol import Symbol, topo_sort
+
+        m, root = export
+        self.cache_shape = tuple(int(d) for d in m["cache_shape"])
+        self.cache_dtype = m["cache_dtype"]
+        with onp.load(os.path.join(root, m["params_file"])) as z:
+            self._params = {n: jnp.asarray(z[n]) for n in z.files}
+        for key, g in m["graphs"].items():
+            sym = Symbol.load(os.path.join(root, g["file"]))
+            var_nodes = [n for n in topo_sort(sym._entries) if n.is_var]
+            by_name = {n.name: n for n in var_nodes}
+            # trace() names data inputs data0..dataN; params keep their
+            # parameter names — rebuild the exact call order
+            ordered, pnames = [], []
+            for i in range(g["n_data"]):
+                if f"data{i}" not in by_name:
+                    raise MXNetError(
+                        f"exported graph {key} is missing input data{i}")
+                ordered.append(by_name[f"data{i}"])
+            for pn in g["params"]:
+                if pn in by_name:      # unused params drop out of the graph
+                    ordered.append(by_name[pn])
+                    pnames.append(pn)
+            missing = set(by_name) - {n.name for n in ordered}
+            if missing:
+                raise MXNetError(
+                    f"exported graph {key} has unbound inputs: "
+                    f"{sorted(missing)}")
+            self._cops[key] = CachedOp(sym, ordered,
+                                       name=f"serve_{key.replace(':', '_')}")
+            self._graph_params[key] = pnames

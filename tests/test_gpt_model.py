@@ -70,6 +70,52 @@ def test_gpt_generate_modes():
     assert len(out_t) == 7
 
 
+def test_gpt_padding_mask_regression():
+    """Pad tokens must be invisible: a right-padded prompt with
+    valid_length produces bitwise the same logits (at valid positions)
+    and the same greedy tokens as the unpadded prompt. The old window
+    loop LEFT-padded with no mask, so pads leaked into attention."""
+    mx.random.seed(4)
+    net = gpt_tiny(vocab_size=40, dropout=0.0, num_layers=2, units=32,
+                   num_heads=4, max_length=64)
+    net.initialize()
+    x = RS.randint(1, 40, size=(1, 5)).astype("int32")
+    plain = net(np.array(x)).asnumpy()
+    padded = onp.zeros((1, 12), "int32")
+    padded[0, :5] = x[0]
+    masked = net(np.array(padded),
+                 np.array(onp.asarray([5], "int32"))).asnumpy()
+    assert onp.abs(masked[0, :5] - plain[0]).max() == 0.0
+
+    # the windowed loop right-pads+masks internally: greedy tokens must
+    # match the cached path, which never pads at all
+    prompt = [int(t) for t in x[0]]
+    want = net.generate(prompt, max_new_tokens=6, temperature=0.0,
+                        use_cache=True)
+    got = net.generate(prompt, max_new_tokens=6, temperature=0.0,
+                       use_cache=False, window=16)
+    assert got == want
+
+
+def test_gpt_generate_cache_routing_and_parity():
+    mx.random.seed(5)
+    net = gpt_tiny(vocab_size=30, dropout=0.0, num_layers=1, units=32,
+                   num_heads=2, max_length=32)
+    net.initialize()
+    prompt = [3, 1, 4, 1, 5, 9]
+    cached = net.generate(prompt, max_new_tokens=8, temperature=0.0)
+    naive = net.generate(prompt, max_new_tokens=8, temperature=0.0,
+                         use_cache=False)
+    assert cached == naive and len(cached) == len(prompt) + 8
+    # past max_length the auto route falls back to the rolling window...
+    long_out = net.generate(prompt, max_new_tokens=40, temperature=0.0)
+    assert len(long_out) == len(prompt) + 40
+    # ...and forcing the cache raises instead of silently clipping
+    with pytest.raises(mx.base.MXNetError, match="max_length"):
+        net.generate(prompt, max_new_tokens=40, temperature=0.0,
+                     use_cache=True)
+
+
 def test_gpt_weight_tying():
     net = gpt_tiny(vocab_size=30, tie_weights=True)
     net.initialize()
