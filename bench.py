@@ -36,27 +36,32 @@ RESNET50_FWD_FLOPS = 4.089e9          # per image, 224x224
 RESNET50_TRAIN_FLOPS = 3 * RESNET50_FWD_FLOPS
 BERT_PARAMS = {"base": 110e6, "large": 340e6}
 
-# peak bf16 FLOP/s per chip, matched by substring of device_kind (lowercase)
-_PEAK_BF16 = [
-    ("v6e", 918e12), ("v6 lite", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12), ("v5e", 197e12), ("v5 lite", 197e12),
-    ("v4", 275e12), ("v3", 105e12), ("v2", 45e12),
-]
-
-
 def _device_info():
+    # peak bf16 FLOP/s comes from telemetry.costs (one table for bench,
+    # step_report MFU and cost_report; MXTPU_PEAK_FLOPS overrides — the
+    # only way to get an MFU on a CPU host)
     try:
         import jax
 
         dev = jax.devices()[0]
         kind = getattr(dev, "device_kind", str(dev))
-        low = kind.lower()
-        for sub, peak in _PEAK_BF16:
-            if sub in low:
-                return kind, peak
-        return kind, None
     except Exception:
         return "unknown", None
+    try:
+        from mxnet_tpu.telemetry.costs import peak_flops_info
+
+        return kind, peak_flops_info()["peak"]
+    except Exception:
+        return kind, None
+
+
+def _peak_source():
+    try:
+        from mxnet_tpu.telemetry.costs import peak_flops_info
+
+        return peak_flops_info()["source"]
+    except Exception:
+        return None
 
 
 def _mfu(flops_per_sec):
@@ -218,6 +223,11 @@ def bench_train_step():
         telemetry.enable() if was_on else telemetry.disable()
     disp = max(r["dispatches"] for r in rows) if rows else -1
     recomp = sum(r["recompiles"] for r in rows) if rows else -1
+    flops_step = max((r.get("flops", 0) for r in rows), default=0)
+    mfus = [r["mfu"] for r in rows if r.get("mfu") is not None]
+    # per-program view: XLA cost_analysis flops joined with the
+    # train_step.call timer (telemetry.cost_report)
+    prog = telemetry.cost_report().get("train_step") or {}
     return {"metric": "train_step_compiled_mlp",
             "value": round(compiled_sps, 2), "unit": "steps/s",
             "vs_baseline": round(compiled_sps / max(eager_sps, 1e-9), 3),
@@ -225,7 +235,12 @@ def bench_train_step():
             "dispatches_per_step": disp,
             "recompiles_after_warmup": recomp,
             "compiled_programs": step._traces,
-            "mfu": None}
+            "flops_per_step": int(flops_step),
+            "achieved_flops_per_sec":
+                (round(prog["achieved_flops_s"], 1)
+                 if prog.get("achieved_flops_s") else None),
+            "peak_flops_source": _peak_source(),
+            "mfu": round(mfus[-1], 4) if mfus else None}
 
 
 def bench_train_step_sharded():
@@ -602,8 +617,11 @@ def bench_telemetry_overhead():
 
     One trainer, jit caches warmed once, then interleaved off/on timing
     trials; the reported overhead is the ratio of the min-of-trials each
-    way — robust to one-off scheduler noise. BENCH_TELEM_SMALL=1 shrinks
-    the tensor set (for the not-slow test); the acceptance bar is < 2%.
+    way — robust to one-off scheduler noise. A second surface covers the
+    serve submit path with per-request tracing live (exporter off): the
+    RequestTrace allocation + phase marks ride the same interleaved
+    pairwise-min protocol. BENCH_TELEM_SMALL=1 shrinks the tensor set
+    (for the not-slow test); the acceptance bar is < 2%.
     """
     import jax
 
@@ -647,12 +665,54 @@ def bench_telemetry_overhead():
     finally:
         telemetry.enable() if was_on else telemetry.disable()
 
+    # tracing surface: batched submits through a warmed Predictor with
+    # max_wait_us=0 — telemetry on allocates a RequestTrace + 4 phase
+    # marks per request; off is a single bool check (new_trace -> None)
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(5)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    net.hybridize()
+    pred = net.predictor(example=mx.nd.array(
+        onp.zeros((8, 16), "float32")), max_batch=8, max_wait_us=0)
+    S_WARM, S_ITERS, S_TRIALS = 10, (40 if small else 80), 6
+    item = onp.zeros(16, "float32")
+
+    def timed_serve(enabled):
+        telemetry.enable() if enabled else telemetry.disable()
+        t0 = time.perf_counter()
+        for _ in range(S_ITERS):
+            # 8 in-flight futures per wave: the trace cost is per request,
+            # the dispatch handoff cost amortizes over the wave
+            for f in [pred.submit(item) for _ in range(8)]:
+                f.result(60)
+        return time.perf_counter() - t0
+
+    try:
+        pred.warmup()
+        for enabled in (False, True):
+            telemetry.enable() if enabled else telemetry.disable()
+            for _ in range(S_WARM):
+                pred.submit(item).result(60)
+        s_off, s_on = [], []
+        for _ in range(S_TRIALS):
+            s_off.append(timed_serve(False))
+            s_on.append(timed_serve(True))
+    finally:
+        pred.close()
+        telemetry.enable() if was_on else telemetry.disable()
+
     # each off/on pair runs back-to-back, so ambient load is comparable
     # within a pair; the min over pair ratios filters box noise that a
     # min-of-each-side comparison cannot (no trial window may be quiet)
     overhead = min(on / max(off, 1e-12)
                    for off, on in zip(t_off, t_on)) - 1.0
     pct = overhead * 100.0
+    serve_pct = (min(on / max(off, 1e-12)
+                     for off, on in zip(s_off, s_on)) - 1.0) * 100.0
     return {"metric": "telemetry_overhead_optimizer_step",
             "value": round(pct, 3), "unit": "%",
             "vs_baseline": round(pct / 2.0, 3),  # fraction of the 2% budget
@@ -660,6 +720,11 @@ def bench_telemetry_overhead():
             "n_tensors": len(shapes),
             "updates_per_sec_off": round(len(shapes) * ITERS / min(t_off), 1),
             "updates_per_sec_on": round(len(shapes) * ITERS / min(t_on), 1),
+            "serve_tracing_overhead_pct": round(serve_pct, 3),
+            "serve_req_per_sec_off":
+                round(8 * S_ITERS * 1.0 / min(s_off), 1),
+            "serve_req_per_sec_on":
+                round(8 * S_ITERS * 1.0 / min(s_on), 1),
             "mfu": None}
 
 
@@ -855,14 +920,24 @@ def bench_serve_llm():
                 f"engine/naive greedy divergence: {got} vs {want}")
 
         c0 = telemetry.metrics()["jit.compiles"]
+        f0 = telemetry.metrics().get("telemetry.flops", 0.0)
+        t_drive = time.perf_counter()
         engine_tps, n_tokens = drive(
             lambda p: eng.submit(p, max_new_tokens=MAX_NEW).result(300))
+        wall = time.perf_counter() - t_drive
+        f1 = telemetry.metrics().get("telemetry.flops", 0.0)
         compiles_steady = int(telemetry.metrics()["jit.compiles"] - c0)
+        # per-request phase decomposition (queue -> prefill -> decode) of
+        # the traces the engine finished during the drive
+        lat = (telemetry.latency_report("serve.decode")
+               or {}).get("serve.decode") or {}
+        tps_chip = telemetry.gauge("serve.tokens_per_s_chip").value
         st = eng.stats()
         eng.close()
     finally:
         telemetry.enable() if was_on else telemetry.disable()
 
+    achieved = (f1 - f0) / max(wall, 1e-9)
     return {"metric": "serve_llm_continuous_batching",
             "value": round(engine_tps, 1), "unit": "tok/s",
             "vs_baseline": round(engine_tps / max(naive_tps, 1e-9), 3),
@@ -874,10 +949,15 @@ def bench_serve_llm():
             "ttft_ms_p99": st["ttft_ms_p99"],
             "tpot_ms_p50": st["tpot_ms_p50"],
             "tpot_ms_p99": st["tpot_ms_p99"],
+            "latency_ms_p99": (lat.get("total_ms") or {}).get("p99"),
+            "latency_p99_decomposition_ms": lat.get("p99_attribution_ms"),
+            "tokens_per_s_chip": round(tps_chip, 1) if tps_chip else None,
             "shed": st["shed"], "evicted": st["evicted"],
             "compiles_warmup": compiles_warmup,
             "compiles_steady": compiles_steady,
-            "mfu": None}
+            "achieved_flops_per_sec": round(achieved, 1),
+            "peak_flops_source": _peak_source(),
+            "mfu": _mfu(achieved)}
 
 
 def _accel_expected():

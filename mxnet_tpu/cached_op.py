@@ -177,7 +177,11 @@ class CachedOp:
         (see ``lower``); callers must rebind those arrays to the
         program's outputs after every call.
         """
-        return self.lower(*example_inputs, donate=donate).compile()
+        compiled = self.lower(*example_inputs, donate=donate).compile()
+        from . import telemetry as _tm
+
+        _tm.record_program_cost(f"cached_op:{self._name}", compiled)
+        return compiled
 
 
 def trace(fn, inputs, params=(), transform=None):

@@ -59,8 +59,15 @@ class Speedometer:
         rec = sum(r["recompiles"] for r in rows)
         comm = sum(r["comm_bytes"] for r in rows)
         coll = sum(r.get("collective_bytes", 0) for r in rows)
-        return (f"\tdispatches={disp}\trecompiles={rec}"
+        text = (f"\tdispatches={disp}\trecompiles={rec}"
                 f"\tcomm={comm}B\tcollective={coll}B")
+        mfus = [r["mfu"] for r in rows if r.get("mfu") is not None]
+        if mfus:
+            text += f"\tmfu={mfus[-1]:.3f}"
+        tps = _tm.REGISTRY.gauge("serve.tokens_per_s_chip").value
+        if tps:
+            text += f"\ttok/s/chip={tps:.0f}"
+        return text
 
     def __call__(self, param):
         if self.sync:

@@ -84,6 +84,13 @@ class LogMetricsCallback:
         for col in self._TELEMETRY_COLS:
             self.summary_writer.add_scalar(
                 f"telemetry/{col}", row[col], self.step)
+        if row.get("mfu") is not None:
+            self.summary_writer.add_scalar(
+                "telemetry/mfu", row["mfu"], self.step)
+        tps = _tm.REGISTRY.gauge("serve.tokens_per_s_chip").value
+        if tps:
+            self.summary_writer.add_scalar(
+                "telemetry/tokens_per_s", tps, self.step)
         for tname, secs in row["host_time"].items():
             self.summary_writer.add_scalar(
                 f"telemetry/host_time/{tname}", secs, self.step)

@@ -67,6 +67,7 @@ class DecodeStream:
         self.tokens = []
         self.expired = False
         self.truncated = False
+        self.trace = None             # RequestTrace when telemetry is on
         self.t_submit = time.perf_counter()
         self._t_last = None           # engine: last emit time (TTFT/TPOT)
         self._on_token = on_token
@@ -213,6 +214,13 @@ class DecodeEngine:
         self._worker_lock = threading.Lock()
         self._closed = False
 
+        # stall heartbeats around the device syncs — where a hung chip
+        # manifests on this path — plus the tokens/s window (single-device
+        # engine: per-chip == total)
+        self._hb_prefill = _tm.stall_heartbeat("serve.prefill")
+        self._hb_tick = _tm.stall_heartbeat("serve.decode_tick")
+        self._tps_mark = None
+
         # -- accounting (always on: these ARE the serving stats) -----------
         self._stats_lock = threading.Lock()
         self._n_requests = 0
@@ -278,6 +286,7 @@ class DecodeEngine:
         if max_new_tokens < 1:
             raise MXNetError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        trace = self._tm.new_trace("serve.decode")
         with self._stats_lock:
             self._n_requests += 1
             over = self._pending_count >= self.max_queue
@@ -287,6 +296,7 @@ class DecodeEngine:
             self._tm.REGISTRY.counter("serve.requests").inc()
         if over:
             self._shed_one()
+            self._tm.finish_trace(trace, status="shed")
             raise ShedError(
                 f"decode queue at budget ({self.max_queue} pending); "
                 "retry later or raise max_queue")
@@ -297,6 +307,7 @@ class DecodeEngine:
         budget = self.max_len - len(toks) + 1
         stream = DecodeStream(toks, min(int(max_new_tokens), budget),
                               deadline, on_token)
+        stream.trace = trace
         if stream.max_new_tokens < max_new_tokens:
             stream.truncated = True
         self._start_worker()
@@ -384,6 +395,7 @@ class DecodeEngine:
                        if s.deadline is not None and now > s.deadline]:
             pending.remove(stream)
             self._shed_one(admitted=True)
+            self._tm.finish_trace(stream.trace, status="shed")
             stream._finish(ShedError(
                 "deadline expired before the request reached a slot"))
         for sid in [s for s, st in self._slot_req.items()
@@ -408,19 +420,32 @@ class DecodeEngine:
         valid = onp.ones((B,), dtype="int32")
         inv = onp.zeros((self.num_slots,), dtype="int32")
         hit = onp.zeros((self.num_slots,), dtype=bool)
+        t_q = time.perf_counter()  # queue phase: submit -> prefill pickup
         for i, (stream, sid) in enumerate(zip(group, slots)):
             tokens[i, :len(stream.prompt)] = stream.prompt
             valid[i] = len(stream.prompt)
             inv[sid] = i
             hit[sid] = True
+            if stream.trace is not None:
+                stream.trace.mark("queue", t_q)
         key = ("prefill", B, T)
         self.programs.ensure("prefill", batch=B, length=T)
-        outs = self.programs.run(key, [
-            jax.device_put(tokens), jax.device_put(valid),
-            jax.device_put(inv), jax.device_put(hit), cache.k, cache.v])
-        cache.rebind(outs[1], outs[2])
-        first = onp.asarray(outs[0])      # device sync: the TTFT tokens
         tm = self._tm
+        hb_on = tm.ON
+        t_run = time.perf_counter()
+        if hb_on:
+            self._hb_prefill.begin()
+        try:
+            outs = self.programs.run(key, [
+                jax.device_put(tokens), jax.device_put(valid),
+                jax.device_put(inv), jax.device_put(hit), cache.k, cache.v])
+            cache.rebind(outs[1], outs[2])
+            first = onp.asarray(outs[0])  # device sync: the TTFT tokens
+        finally:
+            if hb_on:
+                self._hb_prefill.end()
+                tm.REGISTRY.timer("serve.prefill.call").record(
+                    time.perf_counter() - t_run)
         if tm.ON:
             tm.record_dispatch()
         with self._stats_lock:
@@ -442,12 +467,22 @@ class DecodeEngine:
         cache = self._cache
         key = ("decode",)
         self.programs.ensure("decode")
-        outs = self.programs.run(key, [
-            jax.device_put(self._last_tok),
-            jax.device_put(cache.lengths), cache.k, cache.v])
-        cache.rebind(outs[1], outs[2])
-        nxt = onp.asarray(outs[0])        # device sync: this tick's tokens
         tm = self._tm
+        hb_on = tm.ON
+        t_run = time.perf_counter()
+        if hb_on:
+            self._hb_tick.begin()
+        try:
+            outs = self.programs.run(key, [
+                jax.device_put(self._last_tok),
+                jax.device_put(cache.lengths), cache.k, cache.v])
+            cache.rebind(outs[1], outs[2])
+            nxt = onp.asarray(outs[0])    # device sync: this tick's tokens
+        finally:
+            if hb_on:
+                self._hb_tick.end()
+                tm.REGISTRY.timer("serve.decode_tick.call").record(
+                    time.perf_counter() - t_run)
         if tm.ON:
             tm.record_dispatch()
         occ = cache.occupancy()
@@ -465,6 +500,18 @@ class DecodeEngine:
             elif cache.lengths[sid] >= cache.max_len:
                 stream.truncated = True
                 self._retire(sid)
+        if tm.ON:
+            # tokens/s/chip over a ~0.5 s window (single-device engine:
+            # chips == 1, so per-chip is the engine rate)
+            nowt = time.perf_counter()
+            if self._tps_mark is None:
+                self._tps_mark = (nowt, self._n_tokens)
+            else:
+                t0, n0 = self._tps_mark
+                if nowt - t0 >= 0.5:
+                    tm.REGISTRY.gauge("serve.tokens_per_s_chip").set(
+                        (self._n_tokens - n0) / (nowt - t0))
+                    self._tps_mark = (nowt, self._n_tokens)
 
     def _emit_token(self, stream, tok):
         now = time.perf_counter()
@@ -472,6 +519,10 @@ class DecodeEngine:
         if stream._t_last is None:
             ms = (now - stream.t_submit) * 1e3
             self._ttft_ms.record(ms)
+            if stream.trace is not None:
+                # prefill phase: picked up -> first token on host
+                stream.trace.mark("prefill", now)
+                stream.trace.extra["ttft_ms"] = ms
             if tm.ON:
                 tm.REGISTRY.histogram("serve.ttft_ms").record(ms)
         else:
@@ -493,6 +544,13 @@ class DecodeEngine:
         cache.lengths[sid] = 0
         self._last_tok[sid] = 0
         stream.expired = expired
+        if stream.trace is not None:
+            stream.trace.mark("decode")  # first token -> generation done
+            stream.trace.extra["tokens"] = len(stream.tokens)
+            if stream.truncated:
+                stream.trace.extra["truncated"] = True
+        self._tm.finish_trace(stream.trace,
+                              status="evicted" if expired else "completed")
         stream._finish()
         with self._stats_lock:
             self._n_completed += 1
@@ -520,9 +578,11 @@ class DecodeEngine:
         for sid in list(self._slot_req):
             stream = self._slot_req.pop(sid)
             self._cache.slots.free(sid)
+            self._tm.finish_trace(stream.trace, status="closed")
             stream._finish(err)
         for stream in pending:
             self._shed_one(admitted=True)
+            self._tm.finish_trace(stream.trace, status="closed")
             stream._finish(err)
         while True:
             try:
@@ -531,6 +591,7 @@ class DecodeEngine:
                 break
             if item is not _STOP:
                 self._shed_one(admitted=True)
+                self._tm.finish_trace(item.trace, status="closed")
                 item._finish(err)
 
     # ----------------------------------------------------------- reporting

@@ -86,6 +86,7 @@ class DecodePrograms:
         self._graph_params = {}  # graph key -> ordered param names
         self._params = {}       # name -> raw device array
         self._programs = {}     # ("decode",) | ("prefill", B, T) -> Compiled
+        self._costs = {}        # program key -> (flops, bytes_accessed)
         self._signatures = {}   # str key -> trace signature
         self.cache_shape = None  # [S, layers, heads, max_len, head_dim]
         self.cache_dtype = "float32"
@@ -209,6 +210,15 @@ class DecodePrograms:
                            for n in self._graph_params[self._cop_key(key)]]
         prog = _compile(cop, args, donate)
         self._programs[key] = prog
+        # per-program XLA cost, captured once per compile; run() credits
+        # the flops counter with it at every dispatch
+        from ... import telemetry as _tm
+
+        site = ("serve.decode_tick" if kind == "decode"
+                else f"serve.prefill_b{batch}_t{length}")
+        cost = _tm.record_program_cost(site, prog)
+        self._costs[key] = ((cost["flops"], cost["bytes_accessed"])
+                            if cost else (0.0, 0.0))
         self._signatures["|".join(str(k) for k in key)] = format_signature(
             [getattr(x, "_data", x) for x in examples])
         return prog
@@ -228,6 +238,10 @@ class DecodePrograms:
             from ... import random as _rnd
 
             args.insert(0, _rnd._next_key())
+        from ... import telemetry as _tm
+
+        if _tm.ON:
+            _tm.record_flops(*self._costs.get(key, (0.0, 0.0)))
         outs = prog(*args)
         return outs if isinstance(outs, (tuple, list)) else (outs,)
 

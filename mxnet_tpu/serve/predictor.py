@@ -49,12 +49,16 @@ _STOP = object()
 
 
 class _Request:
-    __slots__ = ("rows", "future", "t0")
+    __slots__ = ("rows", "future", "t0", "trace")
 
-    def __init__(self, rows):
+    def __init__(self, rows, trace=None):
         self.rows = rows  # one host row per model input
         self.future = Future()
         self.t0 = time.perf_counter()
+        # RequestTrace when telemetry is on, else None; also exposed as
+        # future.trace so callers can read the phase decomposition
+        self.trace = trace
+        self.future.trace = trace
 
 
 def load_manifest(path):
@@ -167,7 +171,11 @@ class Predictor:
         # -- program table -------------------------------------------------
         self._programs = {}     # bucket -> jax Compiled
         self._signatures = {}   # bucket -> "f32[8,16],..." trace signature
+        self._program_costs = {}  # bucket -> (flops, bytes_accessed)
         self._compile_lock = threading.Lock()
+        # stall heartbeat around the device sync in _resolve — the spot
+        # where a hung device manifests on this path
+        self._hb_resolve = _tm.stall_heartbeat("serve.dispatch")
 
         # -- batcher state -------------------------------------------------
         self._q = queue.SimpleQueue()
@@ -219,6 +227,13 @@ class Predictor:
             prog = self._cop.aot_compile(*examples, *self._param_datas)
             self._signatures[bucket] = format_signature(
                 [x._data for x in examples])
+            # per-bucket XLA cost, captured once per compile (see
+            # telemetry/costs.py) — credited at every dispatch below
+            cost = self._tm.record_program_cost(f"serve.bucket{bucket}",
+                                                prog)
+            self._program_costs[bucket] = (
+                (cost["flops"], cost["bytes_accessed"]) if cost
+                else (0.0, 0.0))
             self._programs[bucket] = prog
             return prog
 
@@ -277,6 +292,7 @@ class Predictor:
         tm = self._tm
         if tm.ON:
             tm.record_dispatch()
+            tm.record_flops(*self._program_costs.get(bucket, (0.0, 0.0)))
         return tuple(outs)[: self._n_out]
 
     def predict(self, data):
@@ -366,7 +382,7 @@ class Predictor:
                     f"{self._item_shapes[i]} for input {i}, got "
                     f"{tuple(x.shape)} — use predict() for whole batches")
             rows.append(x)
-        req = _Request(rows)
+        req = _Request(rows, trace=self._tm.new_trace("serve.request"))
         with self._stats_lock:
             self._n_requests += 1
         if self._tm.ON:
@@ -404,6 +420,8 @@ class Predictor:
                 continue
             if first is _STOP:
                 break
+            if first.trace is not None:  # queue phase: submit -> picked up
+                first.trace.mark("queue")
             batch = [first]
             deadline = time.perf_counter() + self.max_wait_us * 1e-6
             while len(batch) < self.max_batch:
@@ -417,6 +435,8 @@ class Predictor:
                 if nxt is _STOP:
                     stopping = True
                     break
+                if nxt.trace is not None:
+                    nxt.trace.mark("queue")
                 batch.append(nxt)
             current = self._dispatch(batch)
             self._resolve(inflight)
@@ -442,6 +462,10 @@ class Predictor:
         import jax
 
         try:
+            t_batch = time.perf_counter()  # batch phase: picked up -> here
+            for req in batch:
+                if req.trace is not None:
+                    req.trace.mark("batch", t_batch)
             k = len(batch)
             bucket = pick_bucket(k, self.buckets)
             self._ensure_program(bucket)
@@ -455,9 +479,10 @@ class Predictor:
             datas = [jax.device_put(b) for b in bufs]  # async H2D
             outs = self._run_program(bucket, datas)    # async compute
             self._account_batch(k, bucket, qdepth=self._q.qsize())
-            return batch, outs
+            return batch, outs, bucket, time.perf_counter()
         except BaseException as e:  # noqa: BLE001 — fail the futures, not the loop
             for req in batch:
+                self._tm.finish_trace(req.trace, status="error")
                 if not req.future.done():
                     req.future.set_exception(e)
             return None
@@ -469,19 +494,37 @@ class Predictor:
             return
         from ..cached_op import unflatten_out
 
-        batch, outs = inflight
+        batch, outs, bucket, t_disp = inflight
+        tm = self._tm
+        hb_on = tm.ON
+        if hb_on:
+            self._hb_resolve.begin()
         try:
             host = [onp.asarray(o) for o in outs]  # device sync happens here
         except BaseException as e:  # noqa: BLE001
             for req in batch:
+                tm.finish_trace(req.trace, status="error")
                 if not req.future.done():
                     req.future.set_exception(e)
             return
+        finally:
+            if hb_on:
+                self._hb_resolve.end()
         now = time.perf_counter()
-        tm = self._tm
+        if tm.ON:
+            # dispatch->sync wall time per program: cost_report joins this
+            # with the bucket's flops into achieved FLOP/s / MFU
+            tm.REGISTRY.timer(f"serve.bucket{bucket}.call").record(
+                now - t_disp)
         for i, req in enumerate(batch):
             out_rows = [h[i] for h in host]
-            req.future.set_result(unflatten_out(out_rows, self._tree))
+            if req.trace is not None:
+                req.trace.mark("compute", now)  # dispatch+device -> on host
+            res = unflatten_out(out_rows, self._tree)
+            if req.trace is not None:
+                req.trace.mark("host")          # unpad/unflatten
+                tm.finish_trace(req.trace)
+            req.future.set_result(res)
             ms = (now - req.t0) * 1e3
             self._latency_ms.record(ms)
             if tm.ON:

@@ -31,16 +31,24 @@ import os
 from .events import EventLog
 from .registry import Counter, Gauge, Histogram, Registry, Timer
 from .step import StepTracker
+from .trace import RequestTrace, TraceCollector
 from .watchdog import Watchdog, format_signature
 from .monitor import Monitor
+from .stall import StallMonitor
+from . import costs as _costs
 
 __all__ = ["enable", "disable", "is_enabled", "configure", "reset",
            "counter", "gauge", "timer", "histogram", "metrics", "event",
            "events", "dump_events", "export_chrome_trace", "mark_step",
            "program_timer", "step_report", "last_step", "watchdog_stats",
-           "record_fsdp",
+           "record_fsdp", "record_flops", "record_program_cost",
+           "new_trace", "finish_trace", "traces", "latency_report",
+           "cost_report", "program_costs", "device_peak_flops",
+           "start_exporter", "stop_exporter", "exporter_url",
+           "stall_heartbeat", "start_stall_watchdog", "stop_stall_watchdog",
+           "stall_stats",
            "Monitor", "Counter", "Gauge", "Timer", "Histogram", "Registry",
-           "format_signature"]
+           "RequestTrace", "StallMonitor", "format_signature"]
 
 # THE gate. Instrumentation sites read this module attribute directly
 # (``if _telemetry.ON:``) — rebinding a module-level bool is the cheapest
@@ -51,6 +59,17 @@ REGISTRY = Registry()
 EVENTS = EventLog()
 WATCHDOG = Watchdog(warmup_steps=1)
 STEPS = StepTracker(REGISTRY)
+TRACES = TraceCollector()
+from .stall import monitor_from_env as _monitor_from_env  # noqa: E402
+
+STALL = _monitor_from_env()
+EXPORTER = None  # created by start_exporter() / MXTPU_METRICS_PORT
+
+# monotonic stamp of the last compute dispatch (any site): /healthz turns
+# it into seconds-since-last-dispatch, the cheapest liveness signal a
+# hung device produces. One-element list so record_dispatch stays a store,
+# not a global rebind.
+_LAST_DISPATCH = [0.0]
 
 # pre-resolved hot metrics: the dispatch chokepoint and the byte counters
 # must not pay a dict lookup per call
@@ -65,6 +84,10 @@ _C_PULL_BYTES = REGISTRY.counter("kvstore.pull_bytes")
 _C_RS_BYTES = REGISTRY.counter("collective.reduce_scatter_bytes")
 _C_AG_BYTES = REGISTRY.counter("collective.all_gather_bytes")
 _C_PSUM_BYTES = REGISTRY.counter("collective.psum_bytes")
+# statically-known program cost, credited at dispatch time from the
+# per-program cost table (telemetry/costs.py)
+_C_FLOPS = REGISTRY.counter("telemetry.flops")
+_C_BYTES_ACCESSED = REGISTRY.counter("telemetry.bytes_accessed")
 
 
 # -- gating -----------------------------------------------------------------
@@ -95,12 +118,15 @@ def configure(watchdog_warmup_steps=None, max_events=None):
 
 
 def reset():
-    """Zero all metrics, events, step rows and watchdog state (metric
-    objects stay valid — hot sites hold direct references)."""
+    """Zero all metrics, events, step rows, traces and watchdog state
+    (metric objects stay valid — hot sites hold direct references). The
+    program cost table survives: it mirrors compiled programs, which a
+    reset does not discard."""
     REGISTRY.reset()
     EVENTS.clear()
     STEPS.reset()
     WATCHDOG.reset()
+    TRACES.clear()
 
 
 # -- metric access ----------------------------------------------------------
@@ -212,7 +238,19 @@ def record_compile(site, args=None, attrs=None, sig=None):
 
 def record_dispatch(n=1):
     """Count a compute dispatch (callers guard on ``telemetry.ON``)."""
+    import time as _time
+
     _C_DISPATCH.inc(n)
+    _LAST_DISPATCH[0] = _time.monotonic()
+
+
+def record_flops(flops, bytes_accessed=0.0):
+    """Credit one dispatch's statically-known program cost (callers guard
+    on ``telemetry.ON`` and pass the flops captured at compile time)."""
+    if flops:
+        _C_FLOPS.inc(flops)
+    if bytes_accessed:
+        _C_BYTES_ACCESSED.inc(bytes_accessed)
 
 
 def record_comm(push_bytes=0, pull_bytes=0):
@@ -263,5 +301,121 @@ def watchdog_stats():
     return WATCHDOG.site_stats()
 
 
+# -- per-request traces ------------------------------------------------------
+def new_trace(kind):
+    """A RequestTrace when telemetry is ON, else None — the disabled path
+    allocates nothing (``if req.trace is not None`` is the whole cost)."""
+    if not ON:
+        return None
+    return RequestTrace(kind)
+
+
+def finish_trace(trace, status="completed"):
+    """Land a finished trace in the collector (None-tolerant so serve
+    paths can call it unconditionally on their request objects)."""
+    if trace is not None:
+        TRACES.finish(trace, status, event_log=EVENTS if ON else None)
+
+
+def traces(kind=None):
+    """Finished RequestTrace objects (most recent, bounded window)."""
+    return TRACES.traces(kind)
+
+
+def latency_report(kind=None):
+    """Tail-latency attribution per request kind: total p50/p99 decomposed
+    into per-phase time (queue-wait / batch-wait / compute / host for the
+    Predictor; queue / prefill / decode for the decode engine)."""
+    return TRACES.latency_report(kind)
+
+
+# -- program cost accounting -------------------------------------------------
+def record_program_cost(site, compiled):
+    """Capture ``compiled.cost_analysis()`` under ``site`` (unconditional:
+    compile-time only — see telemetry/costs.py)."""
+    return _costs.record_program_cost(site, compiled)
+
+
+def program_costs():
+    return _costs.program_costs()
+
+
+def cost_report():
+    """Per-program flops/bytes joined with the ``<site>.call`` timers into
+    achieved FLOP/s and MFU (None without a known device peak)."""
+    return _costs.cost_report(REGISTRY)
+
+
+def device_peak_flops():
+    return _costs.device_peak_flops()
+
+
+# -- metrics export server ---------------------------------------------------
+def start_exporter(port=0, addr="127.0.0.1", snapshot_path=None,
+                   snapshot_s=0.0):
+    """Start (or return) the process-wide metrics HTTP server; implies
+    ``enable()`` — an exporter over frozen metrics is a trap. ``port=0``
+    binds an ephemeral port; read it back from the returned exporter."""
+    global EXPORTER
+    if EXPORTER is None:
+        from .exporter import MetricsExporter
+
+        enable()
+        EXPORTER = MetricsExporter(port=port, addr=addr, registry=REGISTRY,
+                                   snapshot_path=snapshot_path,
+                                   snapshot_s=snapshot_s)
+    return EXPORTER
+
+
+def stop_exporter():
+    global EXPORTER
+    if EXPORTER is not None:
+        EXPORTER.close()
+        EXPORTER = None
+
+
+def exporter_url():
+    return EXPORTER.url if EXPORTER is not None else None
+
+
+# -- stall watchdog ----------------------------------------------------------
+def stall_heartbeat(name):
+    """The named Heartbeat for a device-blocking site (creates on first
+    use). Sites guard begin/end on ``telemetry.ON``."""
+    return STALL.heartbeat(name)
+
+
+def start_stall_watchdog(timeout_s=None, p99_multiple=None, min_samples=None,
+                         floor_s=None, check_interval_s=None):
+    """Arm the stall monitor thread; implies ``enable()`` (heartbeats are
+    recorded only when telemetry is on)."""
+    STALL.configure(timeout_s=timeout_s, p99_multiple=p99_multiple,
+                    min_samples=min_samples, floor_s=floor_s,
+                    check_interval_s=check_interval_s)
+    enable()
+    return STALL.start()
+
+
+def stop_stall_watchdog():
+    STALL.stop()
+
+
+def stall_stats():
+    return STALL.stats()
+
+
 if os.environ.get("MXNET_TELEMETRY", "").lower() in ("1", "true", "on"):
     enable()
+
+# production switches: a set MXTPU_METRICS_PORT starts the exporter at
+# import, a set MXTPU_STALL_TIMEOUT_S arms the stall monitor — both imply
+# enable(). Unset (the default) costs nothing: no thread, no socket.
+if os.environ.get("MXTPU_METRICS_PORT"):
+    from .exporter import exporter_from_env as _exporter_from_env
+
+    EXPORTER = _exporter_from_env()
+    if EXPORTER is not None:
+        enable()
+if os.environ.get("MXTPU_STALL_TIMEOUT_S"):
+    enable()
+    STALL.start()

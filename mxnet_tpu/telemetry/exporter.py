@@ -1,0 +1,213 @@
+"""Metrics export server: Prometheus text + JSON + health over stdlib http.
+
+``MetricsExporter`` runs a ``ThreadingHTTPServer`` on a daemon thread —
+no dependency beyond the standard library, per the framework's no-new-deps
+rule — serving:
+
+- ``/metrics``       Prometheus text exposition of every Counter / Gauge /
+                     Timer / Histogram (``mxtpu_`` prefix, dots →
+                     underscores; Timers export ``_seconds_total`` +
+                     ``_calls_total``, Histograms export summary quantiles
+                     + ``_sum``/``_count``);
+- ``/metrics.json``  the raw ``telemetry.metrics()`` snapshot plus the
+                     program cost table and stall stats;
+- ``/healthz``       liveness essentials: slots_live, shed rate,
+                     seconds-since-last-dispatch, stalled sites.
+
+A periodic JSONL snapshot writer (one ``{"ts", "metrics"}`` line per
+period) covers the no-scraper deployments. Strictly zero-cost when off:
+nothing here is imported or spawned unless ``start_exporter()`` runs or
+``MXTPU_METRICS_PORT`` is set.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["MetricsExporter", "render_prometheus"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name):
+    return "mxtpu_" + _NAME_RE.sub("_", name)
+
+
+def render_prometheus(registry):
+    """Prometheus text exposition (version 0.0.4) of the registry."""
+    from .registry import Counter, Gauge, Histogram, Timer
+
+    lines = []
+
+    def emit(name, mtype, samples):
+        lines.append(f"# TYPE {name} {mtype}")
+        for suffix, labels, value in samples:
+            if value is None:
+                continue
+            lines.append(f"{name}{suffix}{labels} {value!r}")
+
+    for m in sorted(registry, key=lambda m: m.name):
+        n = _prom_name(m.name)
+        if isinstance(m, Counter):
+            emit(n, "counter", [("", "", m.value)])
+        elif isinstance(m, Gauge):
+            emit(n, "gauge", [("", "", m.value)])
+        elif isinstance(m, Timer):
+            total, count = m.value
+            emit(n + "_seconds_total", "counter", [("", "", total)])
+            emit(n + "_calls_total", "counter", [("", "", count)])
+        elif isinstance(m, Histogram):
+            p50, p90, p99 = m.percentiles(50, 90, 99)
+            emit(n, "summary",
+                 [("", '{quantile="0.5"}', p50),
+                  ("", '{quantile="0.9"}', p90),
+                  ("", '{quantile="0.99"}', p99),
+                  ("_sum", "", m.sum),
+                  ("_count", "", m.count)])
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxtpu-metrics"
+    exporter = None  # bound per server instance in MetricsExporter
+
+    def log_message(self, *a):  # silence per-request stderr lines
+        pass
+
+    def _send(self, code, body, ctype):
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        exp = self.server.exporter
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                exp.scrapes += 1
+                self._send(200, render_prometheus(exp.registry),
+                           "text/plain; version=0.0.4")
+            elif path == "/metrics.json":
+                exp.scrapes += 1
+                self._send(200, json.dumps(exp.json_snapshot()),
+                           "application/json")
+            elif path == "/healthz":
+                body = exp.health()
+                code = 200 if body["status"] == "ok" else 503
+                self._send(code, json.dumps(body), "application/json")
+            else:
+                self._send(404, "not found\n", "text/plain")
+        except BrokenPipeError:
+            pass
+
+
+class MetricsExporter:
+    """HTTP exporter + optional JSONL snapshot thread. ``port=0`` binds an
+    ephemeral port (tests); the bound port is ``self.port``."""
+
+    def __init__(self, port=0, addr="127.0.0.1", registry=None,
+                 snapshot_path=None, snapshot_s=0.0):
+        if registry is None:
+            from . import REGISTRY as registry  # noqa: N813
+        self.registry = registry
+        self.scrapes = 0
+        self.t0 = time.time()
+        self._server = ThreadingHTTPServer((addr, int(port)), _Handler)
+        self._server.exporter = self
+        self._server.daemon_threads = True
+        self.addr = addr
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name="mxtpu-metrics-exporter",
+                                        daemon=True)
+        self._thread.start()
+        self._snap_stop = threading.Event()
+        self._snap_thread = None
+        self.snapshot_path = snapshot_path
+        if snapshot_path and snapshot_s and snapshot_s > 0:
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_loop, args=(float(snapshot_s),),
+                name="mxtpu-metrics-snapshot", daemon=True)
+            self._snap_thread.start()
+
+    @property
+    def url(self):
+        return f"http://{self.addr}:{self.port}"
+
+    # -- payloads ------------------------------------------------------------
+    def json_snapshot(self):
+        from . import metrics, program_costs, stall_stats
+
+        return {"ts": time.time(), "metrics": metrics(),
+                "program_costs": program_costs(),
+                "stall": stall_stats()}
+
+    def health(self):
+        import mxnet_tpu.telemetry as tm
+
+        reqs = tm.REGISTRY.counter("serve.requests").value
+        shed = tm.REGISTRY.counter("serve.shed_total").value
+        last = tm._LAST_DISPATCH[0]
+        stalled = list(tm.STALL.stalled_sites)
+        return {
+            "status": "stalled" if stalled else "ok",
+            "uptime_s": time.time() - self.t0,
+            "telemetry_on": tm.ON,
+            "slots_live": tm.REGISTRY.gauge("serve.slots_live").value,
+            "requests": reqs,
+            "shed_total": shed,
+            "shed_rate": (shed / reqs) if reqs else 0.0,
+            "seconds_since_last_dispatch":
+                (time.monotonic() - last) if last else None,
+            "stalled_sites": stalled,
+            "stalls": tm.REGISTRY.counter("telemetry.stalls").value,
+        }
+
+    # -- snapshot writer -----------------------------------------------------
+    def _snapshot_loop(self, period_s):
+        while not self._snap_stop.wait(period_s):
+            try:
+                with open(self.snapshot_path, "a") as f:
+                    f.write(json.dumps(self.json_snapshot()) + "\n")
+            except OSError:
+                pass  # a full/readonly disk must not kill the exporter
+
+    def close(self):
+        self._snap_stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+        if self._snap_thread is not None:
+            self._snap_thread.join(timeout=5.0)
+
+    def __repr__(self):
+        return f"MetricsExporter({self.url}, scrapes={self.scrapes})"
+
+
+def exporter_from_env():
+    """Build an exporter from MXTPU_METRICS_PORT / MXTPU_METRICS_SNAPSHOT_S
+    (returns None when no port is set — the zero-cost default)."""
+    port = os.environ.get("MXTPU_METRICS_PORT")
+    if not port:
+        return None
+    try:
+        port = int(port)
+    except ValueError:
+        return None
+    snap_s = 0.0
+    try:
+        snap_s = float(os.environ.get("MXTPU_METRICS_SNAPSHOT_S", "0") or 0)
+    except ValueError:
+        pass
+    path = None
+    if snap_s > 0:
+        path = os.environ.get("MXTPU_METRICS_SNAPSHOT_PATH",
+                              f"mxtpu_metrics_{os.getpid()}.jsonl")
+    return MetricsExporter(port=port, snapshot_path=path, snapshot_s=snap_s)

@@ -26,6 +26,8 @@ _ROW_COUNTERS = {
     "reduce_scatter_bytes": "collective.reduce_scatter_bytes",
     "all_gather_bytes": "collective.all_gather_bytes",
     "psum_bytes": "collective.psum_bytes",
+    "flops": "telemetry.flops",
+    "bytes_accessed": "telemetry.bytes_accessed",
 }
 
 _MAX_ROWS = 100_000  # bound memory over arbitrarily long runs
@@ -44,6 +46,8 @@ class StepTracker:
         self._cols = []
         self._timers = []
         self._seen_version = -1
+        self._g_mfu = None
+        self._last_t = None  # perf_counter at the previous mark (MFU dt)
 
     @property
     def steps_marked(self):
@@ -57,6 +61,7 @@ class StepTracker:
         # version), so read the version AFTER
         self._cols = [(col, reg.counter(cname))
                       for col, cname in _ROW_COUNTERS.items()]
+        self._g_mfu = reg.gauge("telemetry.mfu")
         self._timers = [m for m in reg if isinstance(m, Timer)]
         self._seen_version = reg.version
 
@@ -77,6 +82,21 @@ class StepTracker:
             row["collective_bytes"] = (row["reduce_scatter_bytes"] +
                                        row["all_gather_bytes"] +
                                        row["psum_bytes"])
+            # MFU over the step interval: flops credited since the last
+            # mark against wall time x device peak. None on the first row
+            # (no interval yet) or without a known peak (CPU unless
+            # MXTPU_PEAK_FLOPS declares one).
+            now_t = time.perf_counter()
+            dt = (now_t - self._last_t) if self._last_t is not None else None
+            self._last_t = now_t
+            row["step_time_s"] = dt
+            from .costs import device_peak_flops
+
+            peak = device_peak_flops()
+            row["mfu"] = (row["flops"] / (dt * peak)
+                          if (peak and dt and row["flops"]) else None)
+            if row["mfu"] is not None:
+                self._g_mfu.set(row["mfu"])
             host = {}
             for t in self._timers:
                 tot = t._total
@@ -116,3 +136,4 @@ class StepTracker:
             self._rows.clear()
             self._prev = {}
             self._steps = 0
+            self._last_t = None

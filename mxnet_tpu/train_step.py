@@ -107,7 +107,8 @@ class _Program:
     """One compiled step program + the trace metadata needed to drive it."""
 
     __slots__ = ("fn", "uses_rng", "aux_targets", "n_aux", "sharded",
-                 "fsdp", "coll_bytes")
+                 "fsdp", "coll_bytes", "compiled", "flops",
+                 "bytes_accessed")
 
     def __init__(self, fn, uses_rng, aux_targets, sharded=False, fsdp=False,
                  coll_bytes=(0, 0, 0)):
@@ -120,6 +121,12 @@ class _Program:
         # (reduce_scatter, all_gather, psum) bytes per call, known at build
         # time — the host's only window into in-program collective traffic
         self.coll_bytes = coll_bytes
+        # the jax Compiled, bound at first _run via explicit lower+compile
+        # (same single XLA compile the implicit jit call would pay, but
+        # the executable handle stays reachable for cost_analysis)
+        self.compiled = None
+        self.flops = 0.0
+        self.bytes_accessed = 0.0
 
 
 class _ShardedOptState:
@@ -1339,10 +1346,32 @@ class CompiledTrainStep:
         rescale = onp.float32(tr._scale / scale)
         loss_scale = onp.float32(scale)
         self._dispatches += 1
+        args = (ws, ss, fs, x._data, y._data, key, lrs, wds, ts, rescale,
+                loss_scale)
+        if prog.compiled is None:
+            # first dispatch of this signature: lower + compile explicitly
+            # — the one XLA compile the implicit jit call would pay anyway
+            # (the traced body still reports record_compile, so the
+            # watchdog sees it like any jit cache miss), but the Compiled
+            # handle stays reachable for cost_analysis
+            import warnings as _warnings
+
+            with _warnings.catch_warnings():
+                # CPU backends warn that donation is unimplemented; the
+                # copy fallback is correct (the donation is for TPU)
+                _warnings.filterwarnings("ignore", message=".*donat.*",
+                                         category=UserWarning)
+                prog.compiled = prog.fn.lower(*args).compile()
+            cost = _telemetry.record_program_cost("train_step",
+                                                  prog.compiled)
+            if cost:
+                prog.flops = cost["flops"]
+                prog.bytes_accessed = cost["bytes_accessed"]
         if _telemetry.ON:
             # ONE compiled-program call per step; this bypasses the
             # invoke() chokepoint, so count the dispatch here
             _telemetry.record_dispatch()
+            _telemetry.record_flops(prog.flops, prog.bytes_accessed)
             rs_b, ag_b, ps_b = prog.coll_bytes
             if prog.sharded and not self.shard_update:
                 # replicated residency: the host-side state reshard is
@@ -1353,11 +1382,9 @@ class CompiledTrainStep:
             if prog.fsdp:
                 _telemetry.record_fsdp(self._fsdp_layer_bytes)
             with _telemetry.program_timer("train_step"):
-                out = prog.fn(ws, ss, fs, x._data, y._data, key, lrs, wds,
-                              ts, rescale, loss_scale)
+                out = prog.compiled(*args)
         else:
-            out = prog.fn(ws, ss, fs, x._data, y._data, key, lrs, wds, ts,
-                          rescale, loss_scale)
+            out = prog.compiled(*args)
         loss_v, aux, new_ws, new_ss, overflow = out
         if prog.fsdp:
             # outputs ARE the updated bucket shards: no per-param weight

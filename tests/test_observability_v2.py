@@ -1,0 +1,461 @@
+"""Observability v2 (ISSUE 12): per-request tracing through the serve
+paths, XLA cost/MFU accounting, the metrics export server, the stall
+watchdog, the zero-allocation disabled path, and the metric-docs lint."""
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, telemetry as tm
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import gpt_tiny
+from mxnet_tpu.serve.decode import DecodeEngine, ShedError
+from mxnet_tpu.telemetry import costs
+from mxnet_tpu.telemetry.exporter import MetricsExporter
+from mxnet_tpu.telemetry.stall import StallMonitor
+from mxnet_tpu.telemetry.trace import RequestTrace, TraceCollector
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+VOCAB = 50
+MAX_LEN = 64
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    import mxnet_tpu.random as _rnd
+
+    with _rnd._lock:
+        rng_key, rng_pending = _rnd._key, _rnd._pending_seed
+    host_state = _rnd.host_rng.get_state()
+    tm.disable()
+    tm.reset()
+    yield
+    from mxnet_tpu.context import disable_compilation_cache
+
+    disable_compilation_cache()
+    tm.stop_exporter()
+    tm.stop_stall_watchdog()
+    tm.STALL.stalled_sites = ()
+    tm.disable()
+    tm.reset()
+    with _rnd._lock:
+        _rnd._key, _rnd._pending_seed = rng_key, rng_pending
+    _rnd.host_rng.set_state(host_state)
+
+
+@pytest.fixture(scope="module")
+def pred():
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+    net.initialize()
+    net.hybridize()
+    p = net.predictor(example=mx.nd.array(onp.zeros((8, 16), "float32")),
+                      max_batch=8, max_wait_us=0, cache_dir=False)
+    p.warmup()
+    yield p
+    p.close()
+
+
+@pytest.fixture(scope="module")
+def eng():
+    # one slot + queue budget 1: completed / shed / evicted paths are all
+    # reachable deterministically on the same warmed engine
+    mx.random.seed(11)
+    model = gpt_tiny(vocab_size=VOCAB, dropout=0.0, num_layers=2, units=32,
+                     num_heads=4, max_length=MAX_LEN)
+    model.initialize()
+    e = DecodeEngine(model, num_slots=1, max_len=MAX_LEN, max_prompt_len=8,
+                     prefill_batch=1, max_queue=1, max_wait_us=0,
+                     cache_dir=False)
+    e.warmup()
+    yield e
+    e.close()
+
+
+def _wait_first_token(stream, timeout=60):
+    deadline = time.perf_counter() + timeout
+    while not stream.tokens and time.perf_counter() < deadline:
+        time.sleep(0.001)
+    assert stream.tokens, "stream never produced a first token"
+
+
+def _spans_sum_to_total(trace, rel=0.05):
+    total = trace.total_s
+    s = sum(trace.spans().values())
+    assert s == pytest.approx(total, rel=rel, abs=1e-6), \
+        f"phase decomposition {trace.spans()} != total {total}"
+
+
+# -- RequestTrace / TraceCollector units -------------------------------------
+def test_request_trace_decomposition_exact():
+    tr = RequestTrace("k")
+    t0 = tr.t0
+    tr.mark("a", t0 + 0.010)
+    tr.mark("b", t0 + 0.030)
+    tr.mark("a", t0 + 0.070)  # repeated phases accumulate
+    spans = tr.spans()
+    assert spans["a"] == pytest.approx(0.050)
+    assert spans["b"] == pytest.approx(0.020)
+    assert sum(spans.values()) == pytest.approx(tr.total_s)
+    d = tr.to_dict()
+    assert d["total_ms"] == pytest.approx(70.0)
+    assert d["phases_ms"]["a"] == pytest.approx(50.0)
+
+
+def test_trace_collector_statuses_and_latency_report():
+    col = TraceCollector()
+    for i, status in enumerate(["completed", "completed", "shed",
+                                "evicted"]):
+        tr = RequestTrace("serve.x")
+        tr.mark("queue", tr.t0 + 0.01 * (i + 1))
+        tr.mark("compute", tr.t0 + 0.02 * (i + 1))
+        col.finish(tr, status=status)
+    rep = col.latency_report()["serve.x"]
+    assert rep["count"] == 4
+    assert rep["status"] == {"completed": 2, "shed": 1, "evicted": 1}
+    assert set(rep["phases_ms"]) == {"queue", "compute"}
+    assert rep["total_ms"]["p50"] <= rep["total_ms"]["p99"]
+    # the p99 tail here is the single slowest request, so its attribution
+    # sums exactly to its total
+    assert sum(rep["p99_attribution_ms"].values()) == \
+        pytest.approx(rep["total_ms"]["p99"])
+
+    # a trace shed before any phase boundary records its status as the mark
+    tr = RequestTrace("serve.y")
+    col.finish(tr, status="shed")
+    assert col.traces("serve.y")[0].marks[0][0] == "shed"
+
+    # finishing with an event log emits one span per phase
+    class _Log:
+        def __init__(self):
+            self.calls = []
+
+        def emit(self, name, **kw):
+            self.calls.append((name, kw))
+
+    log = _Log()
+    tr = RequestTrace("serve.z")
+    tr.mark("a")
+    col.finish(tr, event_log=log)
+    assert [c[0] for c in log.calls] == ["trace.serve.z.a"]
+    assert log.calls[0][1]["trace_id"] == tr.trace_id
+
+
+# -- Predictor request traces ------------------------------------------------
+def test_predictor_traces_full_phase_decomposition(pred):
+    tm.enable()
+    items = onp.random.RandomState(0).standard_normal(
+        (12, 16)).astype("float32")
+    futs = []
+    barrier = threading.Barrier(7)
+
+    def client(k):
+        barrier.wait()
+        futs.append(pred.submit(items[k]))
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(6)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    for t in threads:
+        t.join()
+    futs += [pred.submit(items[6 + k]) for k in range(6)]
+    for f in futs:
+        f.result(60)
+    for f in futs:
+        tr = f.trace
+        assert tr is not None and tr.status == "completed"
+        assert [p for p, _ in tr.marks] == ["queue", "batch", "compute",
+                                           "host"]
+        _spans_sum_to_total(tr)
+    rep = tm.latency_report("serve.request")["serve.request"]
+    assert rep["count"] >= 12
+    assert set(rep["phases_ms"]) == {"queue", "batch", "compute", "host"}
+    assert rep["total_ms"]["p99"] >= rep["total_ms"]["p50"] > 0
+
+
+# -- decode engine traces: completed / shed / evicted ------------------------
+def test_decode_trace_completed(eng):
+    tm.enable()
+    # sequential: the fixture's queue budget of 1 is for the shed test
+    for k in range(3):
+        s = eng.submit([1 + k, 2], max_new_tokens=4)
+        out = s.result(120)
+        tr = s.trace
+        assert tr is not None and tr.status == "completed"
+        assert [p for p, _ in tr.marks] == ["queue", "prefill", "decode"]
+        assert tr.extra["tokens"] == len(out) == 4
+        assert tr.extra["ttft_ms"] > 0
+        _spans_sum_to_total(tr)
+    rep = tm.latency_report("serve.decode")["serve.decode"]
+    assert rep["status"].get("completed", 0) >= 3
+    assert set(rep["phases_ms"]) == {"queue", "prefill", "decode"}
+
+
+def test_decode_trace_shed_and_evicted(eng):
+    tm.enable()
+    # queue-budget shed: hog pins the only slot, one stream fills the
+    # queue budget, the next submit is shed synchronously
+    hog = eng.submit([1, 2], max_new_tokens=50)
+    _wait_first_token(hog)
+    waiting = eng.submit([3], max_new_tokens=2)
+    with pytest.raises(ShedError, match="queue at budget"):
+        eng.submit([4], max_new_tokens=2)
+    shed = [t for t in tm.traces("serve.decode") if t.status == "shed"]
+    assert len(shed) == 1 and shed[0].marks[0][0] == "shed"
+    assert hog.result(120) and waiting.result(120)
+
+    # live eviction: admitted, then the deadline lapses mid-decode — the
+    # on_token callback fires in the scheduler thread, so sleeping there
+    # throttles ticks enough that 50 tokens cannot beat the deadline
+    victim = eng.submit([7, 8], max_new_tokens=50, deadline_ms=50,
+                        on_token=lambda t: time.sleep(0.01))
+    out = victim.result(120)
+    assert victim.expired
+    tr = victim.trace
+    assert tr.status == "evicted"
+    assert tr.extra["tokens"] == len(out) < 50
+    _spans_sum_to_total(tr)
+    rep = tm.latency_report("serve.decode")["serve.decode"]
+    assert rep["status"].get("shed") == 1
+    assert rep["status"].get("evicted") == 1
+
+
+def test_decode_engine_disabled_no_traces(eng):
+    assert not tm.ON
+    s = eng.submit([5, 6], max_new_tokens=2)
+    assert s.result(120) and s.trace is None
+    assert tm.traces("serve.decode") == []
+
+
+# -- XLA cost accounting / MFU -----------------------------------------------
+def test_cost_report_nonzero_flops_for_jitted_matmul():
+    import jax
+    import jax.numpy as jnp
+
+    compiled = jax.jit(lambda a, b: a @ b).lower(
+        jnp.ones((64, 64), jnp.float32),
+        jnp.ones((64, 64), jnp.float32)).compile()
+    cost = tm.record_program_cost("obs2.matmul", compiled)
+    assert cost is not None
+    # 2*N^3 MACs-as-flops for a 64^3 matmul; accept any same-order figure
+    assert cost["flops"] >= 2 * 64 ** 3 * 0.5
+    assert tm.program_costs()["obs2.matmul"]["compiles"] == 1
+
+    tm.enable()
+    tm.REGISTRY.timer("obs2.matmul.call").record(0.01)
+    row = costs.cost_report(tm.REGISTRY, peak=1e12)["obs2.matmul"]
+    assert row["calls"] == 1
+    assert row["achieved_flops_s"] == pytest.approx(row["flops"] / 0.01)
+    assert 0 < row["mfu"] < 1
+
+
+def test_peak_flops_env_override(monkeypatch):
+    monkeypatch.setenv("MXTPU_PEAK_FLOPS", "2.5e11")
+    info = costs.peak_flops_info()
+    assert info == {"peak": 2.5e11, "source": "env"}
+    assert tm.device_peak_flops() == 2.5e11
+    monkeypatch.setenv("MXTPU_PEAK_FLOPS", "not-a-number")
+    assert costs.peak_flops_info()["peak"] is None
+
+
+def test_step_report_flops_and_mfu_on_cpu(monkeypatch):
+    monkeypatch.setenv("MXTPU_PEAK_FLOPS", "1e12")
+    tm.enable()
+    mx.random.seed(7)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    assert step.fallback_reason is None
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.standard_normal((8, 16)).astype("float32"))
+    y = mx.nd.array((onp.arange(8) % 4).astype("float32"))
+    for _ in range(3):
+        onp.asarray(step(x, y)._data)
+    rows = tm.step_report()
+    assert rows, "no step rows recorded"
+    assert any(r.get("flops", 0) > 0 for r in rows)
+    # first row has no previous step to time against; later rows carry MFU
+    assert any(r.get("mfu") is not None and r["mfu"] > 0 for r in rows)
+    assert tm.REGISTRY.gauge("telemetry.mfu").value > 0
+    prog = tm.cost_report().get("train_step")
+    assert prog and prog["flops"] > 0 and prog["calls"] >= 1
+
+
+# -- metrics export server ---------------------------------------------------
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers.get("Content-Type", ""), \
+            r.read().decode()
+
+
+_PROM_LINE = r"^(?:# (?:TYPE|HELP) \S.*|[a-zA-Z_:][a-zA-Z0-9_:]*" \
+    r"(?:\{[^{}]*\})? \S+)$"
+
+
+def test_metrics_exporter_scrape_and_health(pred):
+    import re
+
+    tm.enable()
+    pred.submit(onp.zeros(16, "float32")).result(60)  # serve_* series live
+    tm.REGISTRY.gauge("telemetry.mfu").set(0.42)
+    exp = tm.start_exporter(port=0)
+    assert tm.start_exporter(port=0) is exp  # idempotent
+    url = tm.exporter_url()
+    assert url and str(exp.port) in url
+
+    status, ctype, body = _get(url + "/metrics")
+    assert status == 200 and ctype.startswith("text/plain")
+    for line in body.splitlines():
+        if line:
+            assert re.match(_PROM_LINE, line), f"malformed line: {line!r}"
+    assert "mxtpu_serve_requests" in body
+    assert "mxtpu_serve_latency_ms" in body      # histogram quantiles
+    assert 'quantile="0.99"' in body
+    assert "mxtpu_telemetry_mfu 0.42" in body
+
+    status, ctype, body = _get(url + "/metrics.json")
+    assert status == 200 and ctype.startswith("application/json")
+    snap = json.loads(body)
+    assert set(snap) == {"ts", "metrics", "program_costs", "stall"}
+    assert snap["metrics"]["serve.requests"] >= 1
+
+    status, _, body = _get(url + "/healthz")
+    health = json.loads(body)
+    assert status == 200 and health["status"] == "ok"
+    assert health["telemetry_on"] is True
+    assert health["requests"] >= 1 and health["shed_rate"] == 0.0
+    assert health["seconds_since_last_dispatch"] is not None
+
+    # stalled sites flip /healthz to 503
+    tm.STALL.stalled_sites = ("serve.decode_tick",)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(url + "/healthz")
+    assert ei.value.code == 503
+    assert json.loads(ei.value.read().decode())["status"] == "stalled"
+    tm.STALL.stalled_sites = ()
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(url + "/nope")
+    assert ei.value.code == 404
+    tm.stop_exporter()
+    assert tm.exporter_url() is None
+
+
+def test_exporter_jsonl_snapshots(tmp_path):
+    tm.enable()
+    path = tmp_path / "snap.jsonl"
+    exp = MetricsExporter(port=0, registry=tm.REGISTRY,
+                          snapshot_path=str(path), snapshot_s=0.05)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not path.exists():
+            time.sleep(0.02)
+        time.sleep(0.1)
+    finally:
+        exp.close()
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines and {"ts", "metrics", "program_costs",
+                      "stall"} <= set(lines[0])
+
+
+# -- stall watchdog ----------------------------------------------------------
+def test_stall_watchdog_fires_once_and_recovers(capsys):
+    tm.enable()
+    mon = StallMonitor(timeout_s=0.05, check_interval_s=0.01)
+    hb = mon.heartbeat("test.site")
+    hb.begin()
+    time.sleep(0.12)
+    assert mon.check_once() == ["test.site"]
+    assert mon.stalled_sites == ("test.site",)
+    assert mon.fired == 1
+    assert tm.REGISTRY.counter("telemetry.stalls").value == 1
+    err = capsys.readouterr().err
+    assert "stall watchdog" in err and "test.site" in err
+    assert "--- thread" in err  # the all-threads stack dump
+
+    # still stalled: no second report for the same episode
+    assert mon.check_once() == ["test.site"]
+    assert mon.fired == 1
+
+    # completion clears the stall and re-arms
+    hb.end()
+    assert mon.check_once() == []
+    assert mon.stalled_sites == ()
+    assert mon.stats()["test.site"]["beats"] == 1
+
+
+def test_stall_watchdog_p99_threshold(capsys):
+    tm.enable()
+    mon = StallMonitor(p99_multiple=2.0, min_samples=4, floor_s=0.01,
+                       check_interval_s=0.01)
+    hb = mon.heartbeat("fast.site")
+    for _ in range(8):  # sub-ms baseline -> threshold = the 10ms floor
+        hb.begin()
+        hb.end()
+    hb.begin()
+    assert mon.check_once() == []  # busy but under threshold
+    time.sleep(0.05)
+    assert mon.check_once() == ["fast.site"]
+    assert "fast.site" in capsys.readouterr().err
+    hb.end()
+
+
+def test_stall_watchdog_thread_lifecycle():
+    mon = StallMonitor(timeout_s=30.0, check_interval_s=0.01)
+    assert not mon.running
+    mon.start()
+    mon.start()  # idempotent
+    assert mon.running
+    mon.stop()
+    assert not mon.running
+
+
+# -- zero cost when disabled -------------------------------------------------
+def test_disabled_path_allocates_nothing(pred):
+    assert not tm.ON
+    assert tm.new_trace("serve.request") is None
+    tm.finish_trace(None)  # tolerated no-op
+    fut = pred.submit(onp.zeros(16, "float32"))
+    fut.result(60)
+    assert fut.trace is None
+    assert tm.traces() == []
+    assert tm.latency_report() == {}
+    assert tm.exporter_url() is None
+
+
+# -- docs lint ----------------------------------------------------------------
+def test_metric_docs_lint():
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "check_metric_docs.py")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_metric_docs_lint_catches_missing(tmp_path):
+    sys.path.insert(0, str(ROOT / "tools"))
+    try:
+        import check_metric_docs as lint
+    finally:
+        sys.path.pop(0)
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "m.py").write_text(
+        'REG.counter("serve.not_documented_anywhere")\n'
+        'REG.timer(f"serve.dyn{b}.call")\n')
+    doc = tmp_path / "DESIGN.md"
+    doc.write_text("only `serve.dyn<N>.call` is documented here\n")
+    missing = lint.missing_names(doc_path=doc, src_root=src)
+    assert set(missing) == {"serve.not_documented_anywhere"}

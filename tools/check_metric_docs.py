@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""Lint: every ``serve.*`` / ``telemetry.*`` metric name created anywhere
+in ``mxnet_tpu/`` must appear in docs/DESIGN.md (the Observability metric
+inventory), so the exported namespace and the documentation cannot drift.
+
+Literal names must appear verbatim; f-string names (dynamic buckets like
+``serve.bucket{bucket}.call``) are checked by their literal prefix up to
+the first ``{``. Exits non-zero listing the undocumented names. Run
+directly or via tests/test_observability_v2.py.
+"""
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+DESIGN = ROOT / "docs" / "DESIGN.md"
+
+# any Registry accessor or direct metric-class construction carrying a
+# serve./telemetry. name, e.g. REGISTRY.counter("serve.requests") or
+# Histogram("serve.ttft_ms", ...)
+_CREATE = re.compile(
+    r"(?:counter|gauge|timer|histogram|Counter|Gauge|Timer|Histogram)\(\s*"
+    r"(f?)([\"'])((?:serve|telemetry)\.[^\"']*)\2")
+
+
+def collect(src_root=None):
+    """{name_or_prefix: [file:line, ...]} over mxnet_tpu/**/*.py."""
+    src_root = pathlib.Path(src_root) if src_root else ROOT / "mxnet_tpu"
+    found = {}
+    for path in sorted(src_root.rglob("*.py")):
+        text = path.read_text()
+        for m in _CREATE.finditer(text):
+            is_f, name = m.group(1), m.group(3)
+            if is_f:
+                name = name.split("{", 1)[0]
+            line = text.count("\n", 0, m.start()) + 1
+            try:
+                rel = path.relative_to(ROOT)
+            except ValueError:  # scanning a tree outside the repo (tests)
+                rel = path
+            found.setdefault(name, []).append(f"{rel}:{line}")
+    return found
+
+
+def missing_names(doc_path=DESIGN, src_root=None):
+    doc = pathlib.Path(doc_path).read_text()
+    return {name: sites for name, sites in collect(src_root).items()
+            if name not in doc}
+
+
+def main():
+    missing = missing_names()
+    if not missing:
+        print(f"metric docs lint: all {len(collect())} serve./telemetry. "
+              "names documented in docs/DESIGN.md")
+        return 0
+    print("metric names missing from docs/DESIGN.md:", file=sys.stderr)
+    for name, sites in sorted(missing.items()):
+        print(f"  {name}  (created at {', '.join(sites)})", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
