@@ -7,7 +7,7 @@ argv[1] or BENCH env: resnet (default) | resnet_train | train_step |
 train_step_sharded (or ``train_step --shard-update``) |
 train_step_fsdp (or ``train_step --shard-params``) | lstm_lm |
 bert_pretrain | bert_large_pretrain | optimizer_step |
-telemetry_overhead | serve.
+telemetry_overhead | serve | serve_llm | checkpoint.
 
 Robustness contract (round-1 postmortem): any failure — backend init,
 compile, OOM — still emits a parseable JSON line with an "error" field and
@@ -960,6 +960,90 @@ def bench_serve_llm():
             "mfu": _mfu(achieved)}
 
 
+def bench_checkpoint():
+    """Checkpoint save stall: p99 step time of a compiled train loop with
+    NO saves vs SYNC saves vs ASYNC saves (every EVERY steps), plus the
+    `checkpoint.save_stall_ms` histogram per regime. Headline is the
+    async p99 step-time inflation over the no-checkpoint baseline in
+    percent (the acceptance bar is <10%); `vs_baseline` carries the
+    sync-vs-async p99 stall ratio (how much stall the background writer
+    removes from the step boundary). BENCH_CHECKPOINT_SMALL=1 shrinks
+    the model/iterations for the not-slow suite."""
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.gluon import nn
+
+    small = os.environ.get("BENCH_CHECKPOINT_SMALL", "") == "1"
+    B, H, WARMUP, ITERS, EVERY = (16, 32, 2, 12, 2) if small \
+        else (64, 256, 5, 100, 5)
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(0)
+    x = mx.nd.array(rs.standard_normal((B, H)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, 10, (B,)).astype("float32"))
+
+    def make():
+        mx.random.seed(7)
+        net = nn.Sequential()
+        net.add(nn.Dense(H, activation="relu"), nn.Dense(H),
+                nn.Dense(10))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05})
+        step = tr.compile_step(net, loss_fn)
+        return net, tr, step
+
+    def run(mode):
+        telemetry.reset()  # per-regime checkpoint.* metrics
+        net, tr, step = make()
+        mgr, tmpd, times = None, None, []
+        try:
+            if mode != "none":
+                tmpd = tempfile.mkdtemp(prefix="mxtpu_bench_ckpt_")
+                mgr = CheckpointManager(tmpd, trainer=tr, net=net, keep=2,
+                                        async_save=(mode == "async"))
+            for _ in range(WARMUP):
+                _sync(step(x, y)._data)
+            for i in range(1, ITERS + 1):
+                t0 = time.perf_counter()
+                _sync(step(x, y)._data)
+                if mgr is not None and i % EVERY == 0:
+                    mgr.save(i)
+                times.append(time.perf_counter() - t0)
+            if mgr is not None:
+                mgr.wait()
+        finally:
+            if mgr is not None:
+                mgr.close()
+            if tmpd:
+                shutil.rmtree(tmpd, ignore_errors=True)
+        arr = onp.asarray(times) * 1e3
+        stall = telemetry.REGISTRY.histogram("checkpoint.save_stall_ms")
+        s50, s99 = stall.percentiles(50, 99)
+        return {"p50_ms": round(float(onp.percentile(arr, 50)), 3),
+                "p99_ms": round(float(onp.percentile(arr, 99)), 3),
+                "mean_ms": round(float(arr.mean()), 3),
+                "stall_ms_p50": round(s50, 3) if s50 is not None else None,
+                "stall_ms_p99": round(s99, 3) if s99 is not None else None}
+
+    base, sync, async_ = run("none"), run("sync"), run("async")
+    p99_delta_pct = 100.0 * (async_["p99_ms"] - base["p99_ms"]) \
+        / max(base["p99_ms"], 1e-9)
+    stall_ratio = (sync["stall_ms_p99"] or 0.0) \
+        / max(async_["stall_ms_p99"] or 0.0, 1e-9)
+    return {"metric": "checkpoint_async_p99_step_inflation",
+            "value": round(p99_delta_pct, 2), "unit": "%",
+            "vs_baseline": round(stall_ratio, 3),
+            "steps": ITERS, "save_every": EVERY,
+            "no_ckpt": base, "sync_save": sync, "async_save": async_,
+            "async_under_10pct": bool(p99_delta_pct < 10.0),
+            "mfu": None}
+
+
 def _accel_expected():
     """True when this machine is configured for an accelerator, so a CPU
     result must be reported as a failure rather than published silently:
@@ -1025,7 +1109,8 @@ def main():
               "optimizer_step": bench_optimizer_step,
               "telemetry_overhead": bench_telemetry_overhead,
               "serve": bench_serve,
-              "serve_llm": bench_serve_llm}[which]
+              "serve_llm": bench_serve_llm,
+              "checkpoint": bench_checkpoint}[which]
         # resolve the backend up front through the hardened probe: a hung
         # or dead TPU runtime must not kill the bench (round-1 failure:
         # raw RuntimeError) — and must not silently publish a CPU number

@@ -75,6 +75,8 @@ from . import util
 from . import parallel
 from . import amp
 from . import serve
+from . import checkpoint
+from . import testing
 
 kv = kvstore
 

@@ -1,0 +1,19 @@
+"""mxnet_tpu.checkpoint — crash-consistent training checkpoints.
+
+The fault-tolerance counterpart to the observability layer: atomic
+(write-to-temp + fsync + rename + checksummed manifest) snapshots of the
+FULL resume state — params, optimizer state, loss scaler, step counts,
+RNG, data-iterator position — taken synchronously or with an async
+background writer so the compiled train step keeps running; keep-last-K
+retention; torn/corrupt snapshots detected and skipped at restore; and
+preemption handling (SIGTERM → finish step → final checkpoint → clean
+exit, auto-resume on restart). Works identically across replicated /
+ZeRO-1 / FSDP residency via the per-param checkpoint bridge. See
+docs/DESIGN.md "Fault tolerance".
+"""
+from .manager import CheckpointManager
+from .preempt import PreemptionGuard, run_preemptible
+from .state import CheckpointableIter, capture_state, restore_state
+
+__all__ = ["CheckpointManager", "PreemptionGuard", "run_preemptible",
+           "CheckpointableIter", "capture_state", "restore_state"]

@@ -1,0 +1,415 @@
+"""CheckpointManager: atomic, crash-consistent, optionally-async
+checkpoints with keep-last-K retention and torn-write detection.
+
+Atomicity protocol (per snapshot)::
+
+    <dir>/.tmp-step-<N>-<pid>/      # 1. write params.npz + state.pkl
+                                    # 2. fsync each file
+                                    # 3. write manifest.json carrying a
+                                    #    sha256 per payload file; fsync
+    <dir>/step-<N>/                 # 4. atomic rename(tmp -> final)
+                                    # 5. fsync the parent directory
+
+A crash — kill -9, OOM, power loss — at ANY point leaves either no
+``step-<N>`` entry (steps 1–4: the debris is a ``.tmp-*`` dir that the
+next save garbage-collects) or a complete one (after 4: rename is atomic
+on POSIX). ``restore_latest`` additionally verifies the manifest parses
+and every payload checksum matches before trusting a checkpoint, so even
+a torn directory that somehow carries the final name (non-atomic network
+filesystems) is detected, counted (``checkpoint.corrupt_skipped``) and
+skipped in favor of the previous valid snapshot.
+
+The async path (``MXTPU_CKPT_ASYNC``, default on) splits a save into the
+blocking device→host snapshot at the step boundary (recorded in
+``checkpoint.save_stall_ms`` — the only stall the train step pays) and a
+background writer thread that serializes + commits; donated-buffer
+training can rebind every device array the very next step because the
+snapshot holds host copies only. One write is in flight at a time; a new
+save first joins the previous writer (that wait is accounted into the
+stall, keeping the metric honest).
+
+Fault points (``mxnet_tpu.testing.chaos``): ``ckpt.write.begin``,
+``ckpt.write.arrays``, ``ckpt.write.manifest``, ``ckpt.write.rename``
+(SIGKILL matrix) and ``ckpt.manifest.corrupt`` (torn-manifest
+simulation). tests/test_checkpoint.py drives all of them.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import shutil
+import threading
+import time
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..testing import chaos
+from .state import capture_state, restore_state
+
+__all__ = ["CheckpointManager"]
+
+_STEP_RE = re.compile(r"^step-(\d+)$")
+_TMP_PREFIX = ".tmp-"
+MANIFEST = "manifest.json"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _fsync_file(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # some filesystems refuse O_RDONLY on dirs; best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for block in iter(lambda: fh.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+class CheckpointManager:
+    """Crash-consistent training checkpoints under one directory.
+
+    Parameters
+    ----------
+    directory : str, optional
+        Checkpoint root (created if missing). Default: ``MXTPU_CKPT_DIR``.
+    trainer : gluon.Trainer, optional
+        Source/target for optimizer state + step counts (and, through its
+        parameter list, the params when ``net`` is omitted).
+    net : Block, optional
+        Source/target for parameters (``collect_params`` naming).
+    loss_scaler : LossScaler, optional
+        Explicit scaler; default: discovered from the trainer's compiled
+        step (``compile_step(loss_scaler=...)``).
+    data_iter : optional
+        Iterator exposing ``state_dict()/load_state_dict()`` (e.g.
+        :class:`checkpoint.CheckpointableIter`) whose position rides
+        along.
+    keep : int
+        Keep-last-K retention (older snapshots deleted after each
+        successful commit; 0 = keep everything). Default:
+        ``MXTPU_CKPT_KEEP`` (3).
+    async_save : bool
+        Default mode for ``save()``. Default: ``MXTPU_CKPT_ASYNC`` (on).
+    """
+
+    def __init__(self, directory=None, *, trainer=None, net=None,
+                 loss_scaler=None, data_iter=None, keep=None,
+                 async_save=None):
+        directory = directory or os.environ.get("MXTPU_CKPT_DIR")
+        if not directory:
+            raise MXNetError(
+                "CheckpointManager needs a directory (argument or "
+                "MXTPU_CKPT_DIR)")
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.trainer = trainer
+        self.net = net
+        self.loss_scaler = loss_scaler
+        self.data_iter = data_iter
+        self.keep = _env_int("MXTPU_CKPT_KEEP", 3) if keep is None \
+            else int(keep)
+        if async_save is None:
+            async_save = os.environ.get("MXTPU_CKPT_ASYNC", "1") \
+                not in ("0", "false", "off")
+        self.async_save = bool(async_save)
+
+        self._writer = None            # in-flight background writer
+        self._writer_error = None      # exception from the last async write
+        self._save_lock = threading.Lock()   # serializes save() callers
+        self._last_path = None
+        self._last_error = None        # last save attempt's failure
+        self._closed = False
+
+        from .. import telemetry as _tm
+
+        self._tm = _tm
+        self._stall_ms = _tm.REGISTRY.histogram("checkpoint.save_stall_ms")
+        _tm.register_health(f"checkpoint:{self.directory}", self._health)
+
+    # ----------------------------------------------------------------- save
+    def save(self, step, block=None, extra=None):
+        """Snapshot the full resume state as checkpoint ``step``.
+
+        ``block=False`` (default: ``not async_save``) returns as soon as
+        the device→host snapshot is taken and a background thread owns
+        the serialize+commit; ``wait()`` joins it. ``block=True`` commits
+        before returning and returns the checkpoint path. Either way the
+        train loop may mutate device state immediately on return."""
+        if self._closed:
+            raise MXNetError("CheckpointManager is closed")
+        block = (not self.async_save) if block is None else bool(block)
+        step = int(step)
+        t0 = time.perf_counter()
+        with self._save_lock:
+            # one write in flight: joining the previous writer is part of
+            # this save's stall (an honest p99, not a hidden queue)
+            self._join_writer()
+            try:
+                params, meta = capture_state(
+                    trainer=self.trainer, net=self.net,
+                    loss_scaler=self.loss_scaler, data_iter=self.data_iter,
+                    extra=extra)
+                meta["step"] = step
+            except BaseException:
+                self._record_failure()
+                raise
+            if block:
+                try:
+                    path = self._write_commit(step, params, meta)
+                finally:
+                    self._stall_ms.record(
+                        (time.perf_counter() - t0) * 1e3)
+                return path
+            t = threading.Thread(
+                target=self._writer_main, args=(step, params, meta),
+                name=f"mxtpu-ckpt-writer-{step}", daemon=True)
+            self._writer = t
+            t.start()
+            self._stall_ms.record((time.perf_counter() - t0) * 1e3)
+            return None
+
+    def wait(self, timeout=None):
+        """Join the in-flight background write (re-raising its failure);
+        returns the last committed checkpoint path."""
+        with self._save_lock:
+            self._join_writer(timeout)
+        return self._last_path
+
+    def _join_writer(self, timeout=None):
+        t, self._writer = self._writer, None
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                self._writer = t
+                raise MXNetError(
+                    "checkpoint writer still running after "
+                    f"{timeout}s (join timeout)")
+        err, self._writer_error = self._writer_error, None
+        if err is not None:
+            raise err
+
+    def _writer_main(self, step, params, meta):
+        try:
+            self._write_commit(step, params, meta)
+        except BaseException as e:  # noqa: BLE001 — surfaced via wait()
+            self._writer_error = e
+
+    def _write_commit(self, step, params, meta):
+        tm = self._tm
+        t0 = time.perf_counter()
+        final = os.path.join(self.directory, f"step-{step:010d}")
+        tmp = os.path.join(self.directory,
+                           f"{_TMP_PREFIX}step-{step:010d}-{os.getpid()}")
+        try:
+            self._gc_stale_tmp(keep=tmp)
+            if os.path.isdir(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            chaos.fault_point("ckpt.write.begin")
+
+            params_path = os.path.join(tmp, "params.npz")
+            with open(params_path, "wb") as fh:
+                onp.savez(fh, **params)
+                fh.flush()
+                os.fsync(fh.fileno())
+            chaos.fault_point("ckpt.write.arrays")
+
+            state_path = os.path.join(tmp, "state.pkl")
+            with open(state_path, "wb") as fh:
+                pickle.dump(meta, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+
+            manifest = {
+                "version": 1,
+                "step": step,
+                "created_unix": time.time(),
+                "files": {
+                    "params.npz": {"sha256": _sha256(params_path),
+                                   "bytes": os.path.getsize(params_path)},
+                    "state.pkl": {"sha256": _sha256(state_path),
+                                  "bytes": os.path.getsize(state_path)},
+                },
+            }
+            body = json.dumps(manifest, indent=1)
+            if chaos.fault_point("ckpt.manifest.corrupt"):
+                # simulated torn manifest write: half the bytes, then junk
+                body = body[: len(body) // 2] + "\x00{torn"
+            mpath = os.path.join(tmp, MANIFEST)
+            with open(mpath, "w") as fh:
+                fh.write(body)
+                fh.flush()
+                os.fsync(fh.fileno())
+            chaos.fault_point("ckpt.write.manifest")
+
+            if os.path.isdir(final):  # re-saving an existing step
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            chaos.fault_point("ckpt.write.rename")
+            _fsync_dir(self.directory)
+        except BaseException:
+            self._record_failure()
+            raise
+        self._last_path = final
+        self._last_error = None
+        nbytes = sum(f["bytes"] for f in manifest["files"].values())
+        tm.REGISTRY.counter("checkpoint.saves").inc()
+        tm.REGISTRY.counter("checkpoint.bytes").inc(nbytes)
+        tm.REGISTRY.gauge("checkpoint.last_step").set(step)
+        tm.REGISTRY.timer("checkpoint.write").record(
+            time.perf_counter() - t0)
+        if tm.ON:
+            tm.event("checkpoint.save", step=step, bytes=nbytes)
+        self._apply_retention()
+        return final
+
+    def _record_failure(self):
+        import sys
+
+        self._last_error = sys.exc_info()[1]
+        self._tm.REGISTRY.counter("checkpoint.failures").inc()
+
+    def _gc_stale_tmp(self, keep=None):
+        # single-writer contract (documented): leftover .tmp-* dirs are
+        # debris from a crashed predecessor, never live concurrent writes
+        for name in os.listdir(self.directory):
+            if not name.startswith(_TMP_PREFIX):
+                continue
+            path = os.path.join(self.directory, name)
+            if path != keep and os.path.isdir(path):
+                shutil.rmtree(path, ignore_errors=True)
+
+    def _apply_retention(self):
+        if self.keep <= 0:
+            return
+        steps = self.steps()
+        for step in steps[: max(0, len(steps) - self.keep)]:
+            shutil.rmtree(
+                os.path.join(self.directory, f"step-{step:010d}"),
+                ignore_errors=True)
+
+    # -------------------------------------------------------------- restore
+    def steps(self):
+        """Committed checkpoint steps, ascending (no validity check)."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self):
+        """Newest VALID checkpoint step (or None) without loading it."""
+        for step in reversed(self.steps()):
+            if self._validate(step) is not None:
+                return step
+        return None
+
+    def _validate(self, step):
+        """Manifest-parse + checksum-verify checkpoint ``step``; returns
+        its directory when intact, else counts + returns None."""
+        path = os.path.join(self.directory, f"step-{step:010d}")
+        try:
+            with open(os.path.join(path, MANIFEST)) as fh:
+                manifest = json.load(fh)
+            if manifest.get("version") != 1 or \
+                    int(manifest.get("step", -1)) != step:
+                raise ValueError("manifest step/version mismatch")
+            for fname, info in manifest["files"].items():
+                fpath = os.path.join(path, fname)
+                if os.path.getsize(fpath) != info["bytes"] or \
+                        _sha256(fpath) != info["sha256"]:
+                    raise ValueError(f"checksum mismatch in {fname}")
+        except BaseException as e:  # noqa: BLE001 — any tear means skip
+            self._tm.REGISTRY.counter("checkpoint.corrupt_skipped").inc()
+            import warnings
+
+            warnings.warn(
+                f"skipping torn/corrupt checkpoint {path}: {e}",
+                stacklevel=3)
+            return None
+        return path
+
+    def restore_latest(self):
+        """Load the newest valid checkpoint into the attached objects
+        (skipping torn/corrupt ones); returns its step, or None when no
+        valid checkpoint exists. The restored run is bitwise-continuable:
+        params, optimizer state, loss-scaler window, step counts, RNG and
+        data-iterator position all match the interrupted run's last
+        committed step boundary."""
+        for step in reversed(self.steps()):
+            path = self._validate(step)
+            if path is None:
+                continue
+            with open(os.path.join(path, "state.pkl"), "rb") as fh:
+                meta = pickle.load(fh)
+            with open(os.path.join(path, "params.npz"), "rb") as fh:
+                params = dict(onp.load(fh))
+            restore_state(params, meta, trainer=self.trainer, net=self.net,
+                          loss_scaler=self.loss_scaler,
+                          data_iter=self.data_iter)
+            tm = self._tm
+            tm.REGISTRY.counter("checkpoint.restores").inc()
+            tm.REGISTRY.gauge("checkpoint.last_step").set(step)
+            if tm.ON:
+                tm.event("checkpoint.restore", step=step)
+            return step
+        return None
+
+    # -------------------------------------------------------------- health
+    def _health(self):
+        if self._last_error is not None or self._writer_error is not None:
+            err = self._last_error or self._writer_error
+            return False, f"last checkpoint attempt failed: {err!r}"
+        return True, {"last_path": self._last_path}
+
+    @property
+    def healthy(self):
+        return self._health()[0]
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self):
+        """Join any in-flight write and drop the health registration."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.wait()
+        finally:
+            self._tm.unregister_health(f"checkpoint:{self.directory}")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,120 @@
+"""Preemption-safe training: SIGTERM/SIGINT → finish the in-flight step →
+final checkpoint → clean exit, with auto-resume-from-latest on restart.
+
+TPU pods (and any spot/preemptible fleet) deliver a SIGTERM with a grace
+window before the kill. :class:`PreemptionGuard` converts that signal
+into a flag the train loop polls at step boundaries — the step that is
+already executing on device completes normally, a final checkpoint
+commits, and the process exits cleanly instead of dying mid-save.
+:func:`run_preemptible` packages the whole loop contract (used by
+``bench.py checkpoint`` and the chaos tests; README shows the pattern):
+
+    with PreemptionGuard() as guard:
+        start = manager.restore_latest() or 0           # auto-resume
+        for step in range(start + 1, n_steps + 1):
+            train_step(step)
+            if guard.requested:                          # finish-then-save
+                manager.save(step, block=True)
+                break
+            if step % save_every == 0:
+                manager.save(step)                       # async
+
+A simulated preemption rides the chaos harness: arm
+``MXTPU_FAULT_PREEMPT_STEP=flag:<k>`` and the guard trips after ``k``
+polled steps — same code path as the real signal, no signal plumbing
+needed in tests.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+
+from ..testing import chaos
+
+__all__ = ["PreemptionGuard", "run_preemptible"]
+
+
+class PreemptionGuard:
+    """Latch SIGTERM/SIGINT (and simulated preemptions) into a poll flag.
+
+    Install via context manager (restores previous handlers on exit) or
+    ``install()``/``uninstall()``. Signal handlers only bind from the
+    main thread — elsewhere the guard still works through ``simulate()``
+    and the chaos point, and ``install()`` is a no-op.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._flag = threading.Event()
+        self._prev = {}
+        self.signal_received = None
+
+    # -- wiring --------------------------------------------------------------
+    def _handler(self, signum, frame):
+        self.signal_received = signum
+        self._flag.set()
+
+    def install(self):
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signal.signal would raise; chaos/simulate still work
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._handler)
+        return self
+
+    def uninstall(self):
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+
+    # -- polling -------------------------------------------------------------
+    def simulate(self):
+        """Trip the guard programmatically (tests, orchestrators)."""
+        self._flag.set()
+
+    @property
+    def requested(self):
+        """True once a preemption signal (real or simulated) has arrived.
+        Polls the ``preempt.step`` chaos point, so
+        ``MXTPU_FAULT_PREEMPT_STEP=flag:<k>`` preempts after k polls."""
+        if chaos.fault_point("preempt.step"):
+            self._flag.set()
+        return self._flag.is_set()
+
+
+def run_preemptible(step_fn, n_steps, manager, save_every=0, guard=None,
+                    on_step=None):
+    """Auto-resuming, preemption-safe step driver.
+
+    Restores the newest valid checkpoint from ``manager``, runs
+    ``step_fn(step)`` for the remaining steps (1-based, inclusive of
+    ``n_steps``), checkpoints every ``save_every`` steps (async by the
+    manager's default), and on preemption finishes the in-flight step,
+    commits a final synchronous checkpoint, and returns. Returns
+    ``(last_completed_step, preempted)``.
+    """
+    start = manager.restore_latest() or 0
+    own = guard is None
+    g = PreemptionGuard() if own else guard
+    if own:
+        g.install()
+    try:
+        for step in range(start + 1, n_steps + 1):
+            step_fn(step)
+            if on_step is not None:
+                on_step(step)
+            if g.requested:
+                manager.save(step, block=True)
+                return step, True
+            if save_every and step % save_every == 0:
+                manager.save(step)
+        manager.wait()
+        return n_steps, False
+    finally:
+        if own:
+            g.uninstall()

@@ -1,0 +1,194 @@
+"""Full-resume-state capture/restore: the device→host bridge under the
+CheckpointManager.
+
+``capture_state`` takes the blocking snapshot at a step boundary — every
+array is copied to host numpy here, so the background writer thread never
+touches device buffers (donated buffers may be rebound by the very next
+step). ``restore_state`` is its inverse. The state captured is everything
+a bitwise-continuable resume needs:
+
+- **params** — via ``Parameter.data()``, which routes through the FSDP
+  provider bridge, so replicated / ZeRO-1 / FSDP runs all snapshot the
+  classic per-param layout (and any mode can restore any mode's file);
+- **optimizer state + step counts** — ``Trainer.states_payload()``
+  (gathers dp-sharded buckets back to per-param arrays; includes
+  ``num_update`` and the per-index update counts);
+- **loss scaler** — ``loss_scale`` and the unskipped-step window of a
+  ``DynamicLossScaler``;
+- **RNG** — the process-global jax threefry key AND the host-side
+  augmentation RandomState (both halves of ``mx.random.seed``'s
+  contract);
+- **data-iterator position** — any iterator exposing ``state_dict()`` /
+  ``load_state_dict()`` (e.g. :class:`CheckpointableIter`).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["capture_state", "restore_state", "CheckpointableIter"]
+
+
+def _find_scaler(trainer, loss_scaler):
+    if loss_scaler is not None:
+        return loss_scaler
+    if trainer is None:
+        return None
+    step = getattr(trainer, "_compiled_step", None)
+    if step is not None and getattr(step, "loss_scaler", None) is not None:
+        return step.loss_scaler
+    return getattr(trainer, "_amp_loss_scaler", None)
+
+
+def _param_map(trainer, net):
+    if net is not None:
+        return dict(net.collect_params())
+    if trainer is not None:
+        return {p.name: p for p in trainer._params}
+    return {}
+
+
+def capture_state(trainer=None, net=None, loss_scaler=None, data_iter=None,
+                  extra=None):
+    """Blocking device→host snapshot; returns ``(params, meta)`` where
+    ``params`` is a flat ``{name: float array}`` dict (bf16 widened to
+    f32 — exact — with the true dtype recorded in ``meta``) and ``meta``
+    is a pure-host pickleable dict."""
+    params, dtypes = {}, {}
+    for name, p in _param_map(trainer, net).items():
+        if p._data is None and p._provider is None:
+            continue  # uninitialized (deferred shape): nothing to save
+        d = p.data()
+        dtypes[name] = str(p.dtype)
+        params[name] = d.astype("float32").asnumpy() \
+            if str(d.dtype) == "bfloat16" else d.asnumpy()
+    meta = {"param_dtypes": dtypes}
+    if trainer is not None:
+        meta["trainer"] = trainer.states_payload()
+    scaler = _find_scaler(trainer, loss_scaler)
+    if scaler is not None:
+        meta["scaler"] = {"loss_scale": float(scaler.loss_scale),
+                          "unskipped": int(getattr(scaler, "_unskipped", 0))}
+    meta["rng"] = _capture_rng()
+    if data_iter is not None:
+        if not hasattr(data_iter, "state_dict"):
+            raise MXNetError(
+                f"data_iter {type(data_iter).__name__} has no state_dict(); "
+                "wrap it in checkpoint.CheckpointableIter to make its "
+                "position resumable")
+        meta["data"] = data_iter.state_dict()
+    if extra is not None:
+        meta["extra"] = extra
+    return params, meta
+
+
+def restore_state(params, meta, trainer=None, net=None, loss_scaler=None,
+                  data_iter=None):
+    """Restore a ``capture_state`` snapshot into live objects. Restores
+    only the pieces present in ``meta`` AND requested via a non-None
+    target (plus the process-global RNG, which has no target object)."""
+    import jax.numpy as jnp
+
+    targets = _param_map(trainer, net)
+    dtypes = meta.get("param_dtypes", {})
+    for name, p in targets.items():
+        if name not in params:
+            raise MXNetError(f"checkpoint is missing parameter {name}")
+        v = jnp.asarray(params[name])
+        want = dtypes.get(name, str(p.dtype))
+        if want == "bfloat16":
+            v = v.astype("bfloat16")
+        elif str(v.dtype) != want:
+            v = v.astype(want)
+        p.set_data(v)
+    if trainer is not None and "trainer" in meta:
+        trainer.load_states_payload(meta["trainer"])
+    scaler = _find_scaler(trainer, loss_scaler)
+    if scaler is not None and "scaler" in meta:
+        scaler.loss_scale = meta["scaler"]["loss_scale"]
+        if hasattr(scaler, "_unskipped"):
+            scaler._unskipped = meta["scaler"]["unskipped"]
+    if "rng" in meta:
+        _restore_rng(meta["rng"])
+    if data_iter is not None and "data" in meta:
+        data_iter.load_state_dict(meta["data"])
+
+
+# -- RNG --------------------------------------------------------------------
+def _capture_rng():
+    from .. import random as rnd
+
+    with rnd._lock:
+        key = None if rnd._key is None else onp.asarray(rnd._key)
+        pending = rnd._pending_seed
+    return {"key": key, "pending_seed": pending,
+            "host_state": rnd.host_rng.get_state()}
+
+
+def _restore_rng(state):
+    import jax.numpy as jnp
+
+    from .. import random as rnd
+
+    with rnd._lock:
+        rnd._pending_seed = state["pending_seed"]
+        rnd._key = None if state["key"] is None \
+            else jnp.asarray(state["key"])
+    rnd.host_rng.set_state(state["host_state"])
+
+
+# -- data iterator ----------------------------------------------------------
+class CheckpointableIter:
+    """Position-tracking wrapper over any restartable batch source.
+
+    ``source`` must be re-iterable (a list of batches, a DataLoader, an
+    ``io.DataIter`` exposing ``reset()`` — anything ``iter()`` accepts
+    repeatedly). The wrapper counts (epoch, offset); ``state_dict()``
+    snapshots the position and ``load_state_dict()`` fast-forwards a
+    fresh iterator by skipping ``offset`` batches into the recorded
+    epoch — so a resumed run sees exactly the batches the interrupted
+    run had not consumed. Deterministic sources (no reshuffle across
+    processes) make the fast-forward exact; that is the same contract
+    ``mx.random.seed`` restoration relies on.
+    """
+
+    def __init__(self, source):
+        self._source = source
+        self._it = None
+        self.epoch = 0
+        self.offset = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._it is None:
+            if hasattr(self._source, "reset"):
+                self._source.reset()
+            self._it = iter(self._source)
+        try:
+            batch = next(self._it)
+        except StopIteration:
+            self.epoch += 1
+            self.offset = 0
+            self._it = None
+            raise
+        self.offset += 1
+        return batch
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "offset": self.offset}
+
+    def load_state_dict(self, state):
+        self.epoch = int(state["epoch"])
+        self.offset = 0
+        self._it = None
+        for _ in range(int(state["offset"])):
+            try:
+                next(self)
+            except StopIteration as e:
+                raise MXNetError(
+                    "cannot fast-forward data iterator to offset "
+                    f"{state['offset']}: source exhausted at {self.offset}"
+                ) from e

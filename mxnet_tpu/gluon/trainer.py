@@ -377,13 +377,14 @@ class Trainer:
             _telemetry.mark_step()
 
     # -- checkpoint ---------------------------------------------------------
-    def save_states(self, fname):
-        """Reference: trainer.py:482."""
-        import pickle
-
-        # sharded-update mode: the state lives as dp-sharded flat buckets;
-        # gather back to the per-param layout so the file format (and any
-        # later load into a replicated run) is unchanged
+    def states_payload(self):
+        """Host-side (numpy, pickleable) snapshot of the optimizer state in
+        the classic per-param layout, whatever the residency mode: the
+        ZeRO-1 / FSDP bridge gathers the dp-sharded flat buckets back to
+        per-param arrays, so the payload (and any later load into a
+        replicated run) is layout-identical across modes. This is the
+        device→host copy the async CheckpointManager takes at a step
+        boundary before handing serialization to its writer thread."""
         states = self._shard_state.gather_states() if self._shard_state \
             else self._states
         payload = []
@@ -392,23 +393,34 @@ class Trainer:
                 payload.append(None)
             else:
                 payload.append({k: v.asnumpy() for k, v in st.items()})
-        with open(fname, "wb") as f:
-            pickle.dump({"states": payload,
-                         "num_update": self._optimizer.num_update,
-                         "index_count": self._optimizer._index_update_count},
-                        f)
+        return {"states": payload,
+                "num_update": self._optimizer.num_update,
+                "index_count": dict(self._optimizer._index_update_count)}
 
-    def load_states(self, fname):
-        import pickle
+    def load_states_payload(self, payload):
+        """Restore a ``states_payload()`` snapshot (re-sharding into the
+        live residency mode when the compiled step runs ZeRO-1 / FSDP)."""
         from ..ndarray.ndarray import NDArray
 
-        with open(fname, "rb") as f:
-            payload = pickle.load(f)
         self._states = [None if st is None else
                         {k: NDArray(v) for k, v in st.items()}
                         for st in payload["states"]]
         self._optimizer.num_update = payload["num_update"]
-        self._optimizer._index_update_count = payload["index_count"]
+        self._optimizer._index_update_count = dict(payload["index_count"])
         if self._shard_state is not None:
             # re-shard the freshly loaded full states (consumes _states)
             self._shard_state.scatter_from_trainer()
+
+    def save_states(self, fname):
+        """Reference: trainer.py:482."""
+        import pickle
+
+        with open(fname, "wb") as f:
+            pickle.dump(self.states_payload(), f)
+
+    def load_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        self.load_states_payload(payload)

@@ -19,9 +19,9 @@ See docs/DESIGN.md "Serving".
 """
 from . import decode
 from .bucketing import bucket_ladder, pick_bucket, split_sizes
-from .decode import DecodeEngine, DecodeStream, ShedError
+from .decode import DecodeEngine, DecodeStream, EngineDeadError, ShedError
 from .predictor import Predictor, load_manifest
 
 __all__ = ["Predictor", "load_manifest", "bucket_ladder", "pick_bucket",
            "split_sizes", "decode", "DecodeEngine", "DecodeStream",
-           "ShedError"]
+           "ShedError", "EngineDeadError"]

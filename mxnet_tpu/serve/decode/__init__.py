@@ -18,8 +18,9 @@ Quick start::
 See docs/DESIGN.md "Continuous-batching decode".
 """
 from .cache import KVCache, SlotAllocator
-from .engine import DecodeEngine, DecodeStream, ShedError
+from .engine import DecodeEngine, DecodeStream, EngineDeadError, ShedError
 from .programs import DecodePrograms, load_decode_manifest
 
-__all__ = ["DecodeEngine", "DecodeStream", "ShedError", "KVCache",
-           "SlotAllocator", "DecodePrograms", "load_decode_manifest"]
+__all__ = ["DecodeEngine", "DecodeStream", "ShedError", "EngineDeadError",
+           "KVCache", "SlotAllocator", "DecodePrograms",
+           "load_decode_manifest"]

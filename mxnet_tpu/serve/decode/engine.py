@@ -15,6 +15,18 @@ tokens as they land. Load past the queue-depth budget (or past its
 deadline before ever reaching a slot) is SHED with :class:`ShedError`;
 a request whose deadline expires mid-generation is EVICTED — its stream
 finishes with the tokens produced so far and ``expired=True``.
+
+Self-healing contract: a scheduler-thread crash can NEVER hang a client.
+Transient program-run failures retry with capped exponential backoff
+(``MXTPU_SERVE_RETRIES`` / ``MXTPU_SERVE_RETRY_BACKOFF_MS`` /
+``MXTPU_SERVE_RETRY_MAX_MS``; ``serve.retries`` counts them); an
+exception that survives the retries fails EVERY live, pending and queued
+stream with :class:`EngineDeadError` carrying the real cause, marks the
+engine dead (telemetry health check → ``/healthz`` 503,
+``serve.scheduler_crashes``), and later ``submit`` raises immediately.
+``drain()`` finishes accepted work while shedding new submissions;
+``resume()`` reopens the gate. Chaos points: ``decode.prefill``,
+``decode.tick`` (see mxnet_tpu.testing.chaos).
 """
 from __future__ import annotations
 
@@ -28,11 +40,12 @@ import numpy as onp
 
 from ...base import MXNetError
 from ...telemetry.registry import Histogram
+from ...testing import chaos
 from ..bucketing import pick_bucket
 from .cache import KVCache
 from .programs import DecodePrograms
 
-__all__ = ["DecodeEngine", "DecodeStream", "ShedError"]
+__all__ = ["DecodeEngine", "DecodeStream", "ShedError", "EngineDeadError"]
 
 _STOP = object()
 
@@ -46,6 +59,15 @@ def _env_int(name, default):
 
 class ShedError(MXNetError):
     """The engine refused (or dropped) a request to protect latency."""
+
+
+class EngineDeadError(MXNetError):
+    """The scheduler thread died; ``__cause__`` carries the real crash.
+
+    Every stream that was live, pending or queued at crash time finishes
+    with this error (never a hang), and every later ``submit`` raises it
+    immediately. The engine's telemetry health check fails, so an
+    attached exporter's ``/healthz`` answers 503."""
 
 
 class DecodeStream:
@@ -213,6 +235,16 @@ class DecodeEngine:
         self._worker = None
         self._worker_lock = threading.Lock()
         self._closed = False
+        self._dead = None        # scheduler crash exception, once fatal
+        self._draining = False   # drain(): shed new submits, finish live
+
+        # transient program-run failures retry before the crash path
+        self._retries = _env_int("MXTPU_SERVE_RETRIES", 2)
+        self._retry_backoff_ms = _env_int("MXTPU_SERVE_RETRY_BACKOFF_MS", 10)
+        self._retry_max_ms = _env_int("MXTPU_SERVE_RETRY_MAX_MS", 1000)
+
+        self._health_name = f"decode_engine:{id(self):x}"
+        _tm.register_health(self._health_name, self._health)
 
         # stall heartbeats around the device syncs — where a hung chip
         # manifests on this path — plus the tokens/s window (single-device
@@ -280,8 +312,21 @@ class DecodeEngine:
         bounds TOTAL time: a request that can't start in time is shed,
         one that can't finish is evicted with partial output.
         """
+        if self._dead is not None:
+            raise EngineDeadError(
+                f"DecodeEngine scheduler crashed: {self._dead!r}"
+            ) from self._dead
         if self._closed:
             raise MXNetError("DecodeEngine is closed")
+        if self._draining:
+            with self._stats_lock:
+                self._n_requests += 1
+            self._shed_one()
+            if self._tm.ON:
+                self._tm.REGISTRY.counter("serve.requests").inc()
+            raise ShedError(
+                "DecodeEngine is draining: new work is shed until "
+                "resume()")
         toks = self._normalize_prompt(prompt)
         if max_new_tokens < 1:
             raise MXNetError(
@@ -342,14 +387,59 @@ class DecodeEngine:
 
     def _loop(self):
         pending = deque()
+        crash = None
         try:
             while not self._gather(pending):
                 self._expire(pending)
                 self._admit(pending)
                 if self._slot_req:
                     self._tick()
+        except BaseException as e:  # noqa: BLE001 — converted, never lost
+            crash = e
         finally:
-            self._drain(pending)
+            if crash is not None:
+                self._scheduler_crashed(crash, pending)
+            else:
+                self._drain(pending)
+
+    def _scheduler_crashed(self, exc, pending):
+        """Fatal scheduler error: mark dead, fail every stream with the
+        real cause, flip the health check (→ /healthz 503)."""
+        self._dead = exc
+        self._closed = True
+        tm = self._tm
+        tm.REGISTRY.counter("serve.scheduler_crashes").inc()
+        if tm.ON:
+            tm.event("serve.scheduler_crash", error=repr(exc))
+        err = EngineDeadError(
+            f"DecodeEngine scheduler crashed: {exc!r}")
+        err.__cause__ = exc
+        self._drain(pending, err=err, status="error")
+
+    def _run_retry(self, key, args, point):
+        """One AOT program run behind the transient-failure retry policy:
+        up to ``MXTPU_SERVE_RETRIES`` retries with exponential backoff
+        capped at ``MXTPU_SERVE_RETRY_MAX_MS``; ``point`` is also a chaos
+        injection site. Exhaustion re-raises into the crash path."""
+        attempt = 0
+        while True:
+            try:
+                chaos.fault_point(point)
+                return self.programs.run(key, args)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — bounded retries
+                if attempt >= self._retries:
+                    raise
+                attempt += 1
+                tm = self._tm
+                tm.REGISTRY.counter("serve.retries").inc()
+                if tm.ON:
+                    tm.event("serve.retry", point=point, attempt=attempt,
+                             error=repr(e))
+                delay_ms = min(self._retry_backoff_ms * (1 << (attempt - 1)),
+                               self._retry_max_ms)
+                time.sleep(delay_ms * 1e-3)
 
     def _gather(self, pending):
         """Pull new requests off the queue. Blocks when fully idle;
@@ -406,7 +496,14 @@ class DecodeEngine:
         while pending and self._cache.slots.free_count:
             n = min(len(pending), self._cache.slots.free_count,
                     self.prefill_batch)
-            self._prefill([pending.popleft() for _ in range(n)])
+            group = [pending.popleft() for _ in range(n)]
+            try:
+                self._prefill(group)
+            except BaseException:
+                # hand the group back so the crash path fails these
+                # streams with the real error instead of losing them
+                pending.extendleft(reversed(group))
+                raise
 
     def _prefill(self, group):
         import jax
@@ -436,9 +533,10 @@ class DecodeEngine:
         if hb_on:
             self._hb_prefill.begin()
         try:
-            outs = self.programs.run(key, [
+            outs = self._run_retry(key, [
                 jax.device_put(tokens), jax.device_put(valid),
-                jax.device_put(inv), jax.device_put(hit), cache.k, cache.v])
+                jax.device_put(inv), jax.device_put(hit), cache.k, cache.v],
+                point="decode.prefill")
             cache.rebind(outs[1], outs[2])
             first = onp.asarray(outs[0])  # device sync: the TTFT tokens
         finally:
@@ -473,9 +571,10 @@ class DecodeEngine:
         if hb_on:
             self._hb_tick.begin()
         try:
-            outs = self.programs.run(key, [
+            outs = self._run_retry(key, [
                 jax.device_put(self._last_tok),
-                jax.device_put(cache.lengths), cache.k, cache.v])
+                jax.device_put(cache.lengths), cache.k, cache.v],
+                point="decode.tick")
             cache.rebind(outs[1], outs[2])
             nxt = onp.asarray(outs[0])    # device sync: this tick's tokens
         finally:
@@ -573,16 +672,17 @@ class DecodeEngine:
             self._tm.REGISTRY.gauge("serve.slots_live").set(
                 len(self._slot_req))
 
-    def _drain(self, pending):
-        err = MXNetError("DecodeEngine closed before completion")
+    def _drain(self, pending, err=None, status="closed"):
+        if err is None:
+            err = MXNetError("DecodeEngine closed before completion")
         for sid in list(self._slot_req):
             stream = self._slot_req.pop(sid)
             self._cache.slots.free(sid)
-            self._tm.finish_trace(stream.trace, status="closed")
+            self._tm.finish_trace(stream.trace, status=status)
             stream._finish(err)
         for stream in pending:
             self._shed_one(admitted=True)
-            self._tm.finish_trace(stream.trace, status="closed")
+            self._tm.finish_trace(stream.trace, status=status)
             stream._finish(err)
         while True:
             try:
@@ -591,7 +691,7 @@ class DecodeEngine:
                 break
             if item is not _STOP:
                 self._shed_one(admitted=True)
-                self._tm.finish_trace(item.trace, status="closed")
+                self._tm.finish_trace(item.trace, status=status)
                 item._finish(err)
 
     # ----------------------------------------------------------- reporting
@@ -618,15 +718,56 @@ class DecodeEngine:
         out["slots_live"] = len(self._slot_req)
         out["num_slots"] = self.num_slots
         out["cache_bytes"] = self._cache.nbytes
+        out["dead"] = self._dead is not None
+        out["draining"] = self._draining
         out["programs"] = sorted(
             "|".join(str(k) for k in key)
             for key in self.programs._programs)
         return out
 
+    # -------------------------------------------------------------- health
+    def _health(self):
+        if self._dead is not None:
+            return False, f"scheduler crashed: {self._dead!r}"
+        return True, {"slots_live": len(self._slot_req),
+                      "draining": self._draining}
+
+    @property
+    def healthy(self):
+        return self._dead is None
+
+    # ---------------------------------------------------------- drain/resume
+    def drain(self, timeout=None):
+        """Shed new submissions (``ShedError``) while already-accepted
+        work — live slots AND queued-but-unslotted requests — runs to
+        completion. Blocks until idle (or ``timeout`` seconds); returns
+        True when fully drained. ``resume()`` reopens the gate."""
+        self._draining = True
+        deadline = None if timeout is None \
+            else time.perf_counter() + float(timeout)
+        while True:
+            with self._stats_lock:
+                pending = self._pending_count
+            if not self._slot_req and pending <= 0:
+                return True
+            if self._dead is not None or self._closed:
+                return not self._slot_req
+            if deadline is not None and time.perf_counter() > deadline:
+                return False
+            time.sleep(0.002)
+
+    def resume(self):
+        """Accept submissions again after :meth:`drain`."""
+        self._draining = False
+
     # ------------------------------------------------------------ lifecycle
     def close(self):
         """Stop the scheduler (idempotent). Live and queued streams
         finish with an error; later ``submit`` raises."""
+        try:
+            self._tm.unregister_health(self._health_name)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
         if self._closed:
             return
         self._closed = True

@@ -41,11 +41,20 @@ import numpy as onp
 
 from ..base import MXNetError
 from ..telemetry.registry import Histogram
+from ..testing import chaos
 from .bucketing import bucket_ladder, padded_rows, pick_bucket, split_sizes
+from .decode.engine import EngineDeadError
 
 __all__ = ["Predictor", "load_manifest"]
 
 _STOP = object()
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 class _Request:
@@ -182,6 +191,17 @@ class Predictor:
         self._worker = None
         self._worker_lock = threading.Lock()
         self._closed = False
+        self._dead = None       # dispatcher crash exception, once fatal
+        self._inflight = None   # the double-buffered batch (crash cleanup)
+        self._pending_batch = None  # popped but not yet dispatched (ditto)
+
+        # transient dispatch failures retry before failing the futures
+        self._retries = _env_int("MXTPU_SERVE_RETRIES", 2)
+        self._retry_backoff_ms = _env_int("MXTPU_SERVE_RETRY_BACKOFF_MS", 10)
+        self._retry_max_ms = _env_int("MXTPU_SERVE_RETRY_MAX_MS", 1000)
+
+        self._health_name = f"predictor:{id(self):x}"
+        _tm.register_health(self._health_name, self._health)
 
         # -- accounting (always on: these ARE the serving stats) -----------
         self._n_requests = 0
@@ -308,6 +328,10 @@ class Predictor:
 
         from ..cached_op import unflatten_out
 
+        if self._dead is not None:
+            raise EngineDeadError(
+                f"Predictor dispatcher crashed: {self._dead!r}"
+            ) from self._dead
         if self._closed:
             raise MXNetError("Predictor is closed")
         NDArray = self._NDArray
@@ -362,6 +386,10 @@ class Predictor:
         items for multi-input models) for dynamic batching; returns a
         ``concurrent.futures.Future`` resolving to the item's output
         (numpy, in the block's output structure)."""
+        if self._dead is not None:
+            raise EngineDeadError(
+                f"Predictor dispatcher crashed: {self._dead!r}"
+            ) from self._dead
         if self._closed:
             raise MXNetError("Predictor is closed")
         items = item if isinstance(item, (tuple, list)) else (item,)
@@ -403,12 +431,54 @@ class Predictor:
                 t.start()
 
     def _dispatch_loop(self):
+        """Crash guard around the dispatcher: an uncaught error fails
+        every queued and in-flight future with :class:`EngineDeadError`
+        (real cause chained) and marks the predictor dead — clients get
+        an exception, never a hang, and the telemetry health check fails
+        (→ ``/healthz`` 503)."""
+        try:
+            self._dispatch_loop_impl()
+        except BaseException as e:  # noqa: BLE001 — converted, never lost
+            self._dispatcher_crashed(e)
+
+    def _dispatcher_crashed(self, exc):
+        self._dead = exc
+        self._closed = True
+        tm = self._tm
+        tm.REGISTRY.counter("serve.scheduler_crashes").inc()
+        if tm.ON:
+            tm.event("serve.dispatcher_crash", error=repr(exc))
+        err = EngineDeadError(f"Predictor dispatcher crashed: {exc!r}")
+        err.__cause__ = exc
+        pending, self._pending_batch = self._pending_batch, None
+        for req in pending or ():
+            tm.finish_trace(req.trace, status="error")
+            if not req.future.done():
+                req.future.set_exception(err)
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            for req in inflight[0]:
+                tm.finish_trace(req.trace, status="error")
+                if not req.future.done():
+                    req.future.set_exception(err)
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if r is not _STOP:
+                tm.finish_trace(r.trace, status="error")
+                if not r.future.done():
+                    r.future.set_exception(err)
+
+    def _dispatch_loop_impl(self):
         """Dispatcher: coalesce -> pad -> transfer -> dispatch; resolve the
         PREVIOUS in-flight batch only after the next one is on the device
         (double buffering: transfer of N+1 overlaps compute of N)."""
         inflight = None
         stopping = False
         while not stopping:
+            self._inflight = inflight
             try:
                 first = self._q.get_nowait() if inflight is not None \
                     else self._q.get()
@@ -423,6 +493,10 @@ class Predictor:
             if first.trace is not None:  # queue phase: submit -> picked up
                 first.trace.mark("queue")
             batch = [first]
+            # popped requests live in neither the queue nor _inflight until
+            # dispatch returns: expose them so a loop crash fails their
+            # futures instead of orphaning them
+            self._pending_batch = batch
             deadline = time.perf_counter() + self.max_wait_us * 1e-6
             while len(batch) < self.max_batch:
                 remaining = deadline - time.perf_counter()
@@ -439,6 +513,7 @@ class Predictor:
                     nxt.trace.mark("queue")
                 batch.append(nxt)
             current = self._dispatch(batch)
+            self._pending_batch = None
             self._resolve(inflight)
             inflight = current
         self._resolve(inflight)
@@ -454,7 +529,10 @@ class Predictor:
         while leftovers:
             chunk, leftovers = leftovers[:self.max_batch], \
                 leftovers[self.max_batch:]
-            self._resolve(self._dispatch(chunk))
+            self._pending_batch = chunk
+            out = self._dispatch(chunk)
+            self._pending_batch = None
+            self._resolve(out)
 
     def _dispatch(self, batch):
         """Pad the coalesced requests into one device batch and launch the
@@ -477,7 +555,7 @@ class Predictor:
                     buf[r_i] = req.rows[i]
                 bufs.append(buf)
             datas = [jax.device_put(b) for b in bufs]  # async H2D
-            outs = self._run_program(bucket, datas)    # async compute
+            outs = self._run_retry(bucket, datas)      # async compute
             self._account_batch(k, bucket, qdepth=self._q.qsize())
             return batch, outs, bucket, time.perf_counter()
         except BaseException as e:  # noqa: BLE001 — fail the futures, not the loop
@@ -486,6 +564,32 @@ class Predictor:
                 if not req.future.done():
                     req.future.set_exception(e)
             return None
+
+    def _run_retry(self, bucket, datas):
+        """One program launch behind the transient-failure retry policy
+        (``MXTPU_SERVE_RETRIES`` retries, exponential backoff capped at
+        ``MXTPU_SERVE_RETRY_MAX_MS``); ``serve.dispatch`` is the chaos
+        injection site. Exhaustion fails this batch's futures only — the
+        dispatcher itself stays up for later traffic."""
+        attempt = 0
+        while True:
+            try:
+                chaos.fault_point("serve.dispatch")
+                return self._run_program(bucket, datas)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as e:  # noqa: BLE001 — bounded retries
+                if attempt >= self._retries:
+                    raise
+                attempt += 1
+                tm = self._tm
+                tm.REGISTRY.counter("serve.retries").inc()
+                if tm.ON:
+                    tm.event("serve.retry", point="serve.dispatch",
+                             attempt=attempt, error=repr(e))
+                delay_ms = min(self._retry_backoff_ms * (1 << (attempt - 1)),
+                               self._retry_max_ms)
+                time.sleep(delay_ms * 1e-3)
 
     def _resolve(self, inflight):
         """Block on an in-flight batch's device results and complete its
@@ -568,12 +672,27 @@ class Predictor:
             "programs": sorted(self._programs),
             "latency_ms_p50": p50,
             "latency_ms_p99": p99,
+            "dead": self._dead is not None,
         }
+
+    # -------------------------------------------------------------- health
+    def _health(self):
+        if self._dead is not None:
+            return False, f"dispatcher crashed: {self._dead!r}"
+        return True, {"closed": self._closed}
+
+    @property
+    def healthy(self):
+        return self._dead is None
 
     # ------------------------------------------------------------ lifecycle
     def close(self):
         """Stop the dispatcher (idempotent). Outstanding futures resolve
         before the worker exits; later ``submit``/``predict`` raise."""
+        try:
+            self._tm.unregister_health(self._health_name)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
         if self._closed:
             return
         self._closed = True

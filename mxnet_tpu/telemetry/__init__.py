@@ -47,6 +47,7 @@ __all__ = ["enable", "disable", "is_enabled", "configure", "reset",
            "start_exporter", "stop_exporter", "exporter_url",
            "stall_heartbeat", "start_stall_watchdog", "stop_stall_watchdog",
            "stall_stats",
+           "register_health", "unregister_health", "health_checks",
            "Monitor", "Counter", "Gauge", "Timer", "Histogram", "Registry",
            "RequestTrace", "StallMonitor", "format_signature"]
 
@@ -376,6 +377,46 @@ def stop_exporter():
 
 def exporter_url():
     return EXPORTER.url if EXPORTER is not None else None
+
+
+# -- component health registry -----------------------------------------------
+# Long-lived components (DecodeEngine scheduler, Predictor dispatcher,
+# CheckpointManager) register a liveness check; /healthz folds them in and
+# returns 503 while any check fails — the serving self-healing contract's
+# externally visible half. Checks run on the exporter's request thread, so
+# they must be cheap flag reads.
+import threading as _threading  # noqa: E402
+
+_HEALTH_LOCK = _threading.Lock()
+_HEALTH = {}  # name -> callable returning (ok: bool, detail)
+
+
+def register_health(name, check):
+    """Register ``check() -> (ok, detail)`` under ``name`` (idempotent:
+    re-registering a name replaces the check). Components unregister in
+    their ``close()``."""
+    with _HEALTH_LOCK:
+        _HEALTH[name] = check
+
+
+def unregister_health(name):
+    with _HEALTH_LOCK:
+        _HEALTH.pop(name, None)
+
+
+def health_checks():
+    """{name: {"ok": bool, "detail": ...}} over every registered check; a
+    check that raises reports unhealthy with the exception as detail."""
+    with _HEALTH_LOCK:
+        items = list(_HEALTH.items())
+    out = {}
+    for name, check in items:
+        try:
+            ok, detail = check()
+        except Exception as e:  # noqa: BLE001 — a broken check is unhealthy
+            ok, detail = False, f"health check raised: {e!r}"
+        out[name] = {"ok": bool(ok), "detail": detail}
+    return out
 
 
 # -- stall watchdog ----------------------------------------------------------

@@ -156,8 +156,18 @@ class MetricsExporter:
         shed = tm.REGISTRY.counter("serve.shed_total").value
         last = tm._LAST_DISPATCH[0]
         stalled = list(tm.STALL.stalled_sites)
+        # component checks (decode scheduler alive, last checkpoint attempt
+        # ok, ...): any failing check is a 503 — load balancers must stop
+        # routing to a process whose scheduler thread is dead even though
+        # the HTTP server happily answers
+        checks = tm.health_checks()
+        failing = sorted(n for n, c in checks.items() if not c["ok"])
+        status = "unhealthy" if failing else (
+            "stalled" if stalled else "ok")
         return {
-            "status": "stalled" if stalled else "ok",
+            "status": status,
+            "failing_checks": failing,
+            "checks": checks,
             "uptime_s": time.time() - self.t0,
             "telemetry_on": tm.ON,
             "slots_live": tm.REGISTRY.gauge("serve.slots_live").value,

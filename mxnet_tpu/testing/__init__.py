@@ -1,0 +1,9 @@
+"""mxnet_tpu.testing — fault injection and robustness test harnesses.
+
+Production code imports only :mod:`chaos` (stdlib-only, near-zero cost
+when no fault is armed); everything else here is test-side tooling.
+"""
+from . import chaos
+from .chaos import FaultError, fault_point
+
+__all__ = ["chaos", "FaultError", "fault_point"]

@@ -54,3 +54,5 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "seed(n): fix the RNG seed for a test")
     config.addinivalue_line("markers", "serial: run in isolation")
     config.addinivalue_line("markers", "integration: end-to-end tests")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection tests (MXTPU_FAULT_* harness)")

@@ -1,0 +1,288 @@
+"""Fault-injection harness (mxnet_tpu.testing.chaos) and serving
+self-healing (ISSUE 13): spec grammar / arming semantics, SIGKILL
+injection, DecodeEngine scheduler-crash semantics (every pending stream
+fails with the real error — never a hang — and /healthz flips to 503),
+transient-failure retry recovery, Predictor dispatcher crash/batch
+isolation, and drain/resume."""
+import json
+import signal
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import gpt_tiny
+from mxnet_tpu.serve import EngineDeadError, Predictor
+from mxnet_tpu.serve.decode import DecodeEngine, ShedError
+from mxnet_tpu.testing import chaos
+
+VOCAB = 50
+MAX_LEN = 32
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    import mxnet_tpu.random as _rnd
+
+    with _rnd._lock:
+        rng_key, rng_pending = _rnd._key, _rnd._pending_seed
+    host_state = _rnd.host_rng.get_state()
+    tm.disable()
+    tm.reset()
+    chaos.clear()
+    yield
+    from mxnet_tpu.context import disable_compilation_cache
+
+    disable_compilation_cache()
+    chaos.clear()
+    tm.stop_exporter()
+    tm.disable()
+    tm.reset()
+    with _rnd._lock:
+        _rnd._key, _rnd._pending_seed = rng_key, rng_pending
+    _rnd.host_rng.set_state(host_state)
+
+
+# -- harness semantics -------------------------------------------------------
+def test_env_name_mapping():
+    assert chaos.env_name("ckpt.write.manifest") == \
+        "MXTPU_FAULT_CKPT_WRITE_MANIFEST"
+    assert chaos.env_name("decode.tick") == "MXTPU_FAULT_DECODE_TICK"
+
+
+def test_unarmed_point_is_noop():
+    assert chaos.fault_point("no.such.point") is False
+    assert chaos.armed("no.such.point") is None
+
+
+def test_inject_countdown_and_times():
+    chaos.inject("t.p", "raise", countdown=2, times=2)
+    assert chaos.armed("t.p") == ("raise", 2, 2)
+    assert chaos.fault_point("t.p") is False   # countdown 2 -> 1
+    assert chaos.fault_point("t.p") is False   # countdown 1 -> 0
+    with pytest.raises(chaos.FaultError):
+        chaos.fault_point("t.p")               # fire 1/2
+    with pytest.raises(chaos.FaultError):
+        chaos.fault_point("t.p")               # fire 2/2, disarms
+    assert chaos.fault_point("t.p") is False
+    assert chaos.armed("t.p") is None
+    assert tm.REGISTRY.counter("fault.injected").value == 2
+
+
+def test_corrupt_and_flag_return_true():
+    chaos.inject("t.c", "corrupt")
+    assert chaos.fault_point("t.c") is True
+    chaos.inject("t.f", "flag", times=2)
+    assert chaos.fault_point("t.f") is True
+    assert chaos.fault_point("t.f") is True
+    assert chaos.fault_point("t.f") is False
+
+
+def test_env_spec_parsing(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_SOME_POINT", "raise:1:1")
+    chaos.refresh()
+    assert chaos.armed("some.point") == ("raise", 1, 1)
+    assert chaos.fault_point("some.point") is False
+    with pytest.raises(chaos.FaultError):
+        chaos.fault_point("some.point")
+    chaos.clear("some.point")
+
+
+def test_unknown_action_rejected():
+    with pytest.raises(MXNetError, match="unknown fault action"):
+        chaos.inject("t.x", "explode")
+
+
+def test_clear_disarms_everything():
+    chaos.inject("t.a", "raise")
+    chaos.inject("t.b", "flag")
+    chaos.clear()
+    assert chaos.fault_point("t.a") is False
+    assert chaos.fault_point("t.b") is False
+
+
+@pytest.mark.chaos
+@pytest.mark.integration
+def test_die_is_a_real_sigkill():
+    """`die` must be indistinguishable from kill -9: no cleanup, no
+    traceback, returncode -SIGKILL."""
+    child = ("import mxnet_tpu\n"
+             "from mxnet_tpu.testing import chaos\n"
+             "chaos.inject('t.die', 'die')\n"
+             "chaos.fault_point('t.die')\n"
+             "print('SURVIVED')\n")
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL
+    assert "SURVIVED" not in proc.stdout
+    assert "[chaos] SIGKILL at fault point" in proc.stderr
+
+
+# -- decode engine self-healing ----------------------------------------------
+@pytest.fixture(scope="module")
+def net():
+    mx.random.seed(7)
+    model = gpt_tiny(vocab_size=VOCAB, dropout=0.0, num_layers=1, units=16,
+                     num_heads=2, max_length=MAX_LEN)
+    model.initialize()
+    return model
+
+
+def _engine(net, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_len", MAX_LEN)
+    kw.setdefault("max_prompt_len", 8)
+    kw.setdefault("prefill_batch", 2)
+    kw.setdefault("cache_dir", False)
+    return DecodeEngine(net, **kw)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("point", ["decode.prefill", "decode.tick"])
+def test_engine_transient_failure_retried(net, point):
+    """A program-run failure that clears within the retry budget is
+    invisible to clients (counted in serve.retries)."""
+    eng = _engine(net)
+    try:
+        chaos.inject(point, "raise", countdown=0, times=2)  # budget is 2
+        stream = eng.submit([3, 1, 4], max_new_tokens=4)
+        out = stream.result(timeout=120)
+        assert len(out) == 4
+        assert eng.healthy and eng.stats()["dead"] is False
+        assert tm.REGISTRY.counter("serve.retries").value == 2
+    finally:
+        eng.close()
+
+
+@pytest.mark.chaos
+def test_engine_scheduler_crash_fails_all_streams(net):
+    """Terminal scheduler crash: every pending stream raises
+    EngineDeadError carrying the real cause, submit refuses, the health
+    check fails, and a REAL /healthz endpoint serves 503 until the dead
+    engine is closed. Nothing hangs."""
+    eng = _engine(net)
+    exporter = tm.start_exporter(port=0)
+    url = f"http://127.0.0.1:{exporter.port}/healthz"
+    try:
+        chaos.inject("decode.tick", "raise", countdown=0, times=50)
+        streams = [eng.submit([2, 7, 1], max_new_tokens=4),
+                   eng.submit([5, 9], max_new_tokens=4)]
+        for s in streams:
+            with pytest.raises(EngineDeadError) as exc_info:
+                s.result(timeout=120)
+            assert isinstance(exc_info.value.__cause__, chaos.FaultError)
+        with pytest.raises(EngineDeadError):
+            eng.submit([1, 2], max_new_tokens=2)
+        assert not eng.healthy
+        assert eng.stats()["dead"] is True
+        assert tm.REGISTRY.counter("serve.scheduler_crashes").value == 1
+
+        with pytest.raises(urllib.error.HTTPError) as http_err:
+            urllib.request.urlopen(url, timeout=10)
+        assert http_err.value.code == 503
+        body = json.loads(http_err.value.read())
+        assert body["status"] == "unhealthy"
+        assert any(n.startswith("decode_engine:")
+                   for n in body["failing_checks"])
+
+        eng.close()  # dead-engine close still unregisters the check
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+    finally:
+        eng.close()
+        tm.stop_exporter()
+
+
+def test_engine_drain_sheds_new_finishes_live(net):
+    """drain(): already-accepted work runs to completion while new
+    submits shed; resume() reopens the engine."""
+    eng = _engine(net)
+    try:
+        stream = eng.submit([4, 2], max_new_tokens=4)
+        assert eng.drain(timeout=120) is True
+        assert len(stream.result(timeout=1)) == 4  # finished during drain
+        assert eng.stats()["draining"] is True
+        with pytest.raises(ShedError):
+            eng.submit([1], max_new_tokens=2)
+        eng.resume()
+        out = eng.submit([1, 2, 3], max_new_tokens=3).result(timeout=120)
+        assert len(out) == 3
+    finally:
+        eng.close()
+
+
+# -- predictor self-healing --------------------------------------------------
+def _predictor():
+    mx.random.seed(13)
+    block = nn.Dense(4, in_units=3)
+    block.initialize()
+    block.hybridize()
+    return Predictor(block, example=mx.nd.zeros((2, 3)), max_batch=4,
+                     cache_dir=False, max_wait_us=100)
+
+
+@pytest.mark.chaos
+def test_predictor_transient_dispatch_retried():
+    pred = _predictor()
+    try:
+        chaos.inject("serve.dispatch", "raise", countdown=0, times=1)
+        futs = [pred.submit(mx.nd.ones((3,)) * i) for i in range(2)]
+        for f in futs:
+            assert onp.asarray(f.result(timeout=60)).shape == (4,)
+        assert pred.healthy
+        assert tm.REGISTRY.counter("serve.retries").value >= 1
+    finally:
+        pred.close()
+
+
+@pytest.mark.chaos
+def test_predictor_terminal_dispatch_fails_only_that_batch():
+    """Retry exhaustion on one batch fails that batch's futures with the
+    real error; the dispatcher survives and serves later traffic."""
+    pred = _predictor()
+    try:
+        chaos.inject("serve.dispatch", "raise", countdown=0, times=50)
+        f = pred.submit(mx.nd.ones((3,)))
+        with pytest.raises(chaos.FaultError):
+            f.result(timeout=60)
+        chaos.clear("serve.dispatch")
+        assert pred.healthy and pred.stats()["dead"] is False
+        f2 = pred.submit(mx.nd.ones((3,)))
+        assert onp.asarray(f2.result(timeout=60)).shape == (4,)
+    finally:
+        pred.close()
+
+
+def test_predictor_dispatcher_crash_fails_everything():
+    """A crash of the dispatch loop itself (not a program failure) is
+    terminal: queued futures error, submit refuses, health fails."""
+    pred = _predictor()
+    try:
+        boom = RuntimeError("dispatcher exploded")
+
+        def bad_dispatch(batch):
+            raise boom
+
+        pred._dispatch = bad_dispatch
+        f = pred.submit(mx.nd.ones((3,)))
+        with pytest.raises(EngineDeadError) as exc_info:
+            f.result(timeout=60)
+        assert exc_info.value.__cause__ is boom
+        with pytest.raises(EngineDeadError):
+            pred.submit(mx.nd.ones((3,)))
+        assert not pred.healthy
+        assert pred.stats()["dead"] is True
+        checks = tm.health_checks()
+        name = f"predictor:{id(pred):x}"
+        assert checks[name]["ok"] is False
+        assert tm.REGISTRY.counter("serve.scheduler_crashes").value == 1
+    finally:
+        pred.close()
+    assert f"predictor:{id(pred):x}" not in tm.health_checks()

@@ -1,0 +1,435 @@
+"""Crash-consistent checkpointing (ISSUE 13): atomic commit protocol,
+torn/corrupt detection, keep-last-K retention, async save semantics,
+bitwise resume parity across replicated / ZeRO-1 / FSDP, the
+kill-during-save subprocess matrix, preemption handling, and the
+bench.py checkpoint smoke."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, telemetry as tm
+from mxnet_tpu.amp import DynamicLossScaler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import (CheckpointableIter, CheckpointManager,
+                                  PreemptionGuard, run_preemptible)
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.mesh import make_mesh
+from mxnet_tpu.testing import chaos
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    """Telemetry + chaos + RNG isolation: checkpoint restores rewrite the
+    process-global RNG, so snapshot it around every test."""
+    import mxnet_tpu.random as _rnd
+
+    with _rnd._lock:
+        rng_key, rng_pending = _rnd._key, _rnd._pending_seed
+    host_state = _rnd.host_rng.get_state()
+    tm.disable()
+    tm.reset()
+    chaos.clear()
+    yield
+    chaos.clear()
+    tm.disable()
+    tm.reset()
+    with _rnd._lock:
+        _rnd._key, _rnd._pending_seed = rng_key, rng_pending
+    _rnd.host_rng.set_state(host_state)
+
+
+def _make_net(seed=0, hidden=16, classes=4):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(hidden, activation="relu"), nn.Dense(classes))
+    net.initialize()
+    net(mx.nd.zeros((1, 8)))  # settle deferred shapes
+    return net
+
+
+def _batch(b=16, d=8, classes=4, seed=0):
+    rs = onp.random.RandomState(seed)
+    x = mx.nd.array(rs.standard_normal((b, d)).astype("float32"))
+    y = mx.nd.array(rs.randint(0, classes, (b,)).astype("float32"))
+    return x, y
+
+
+def _bits_equal(a, b):
+    return (onp.asarray(a, onp.float32).view(onp.uint32)
+            == onp.asarray(b, onp.float32).view(onp.uint32)).all()
+
+
+def _assert_params_bitwise(net_a, net_b):
+    for (name, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                   net_b.collect_params().items()):
+        a, b = pa.data().asnumpy(), pb.data().asnumpy()
+        assert _bits_equal(a, b), \
+            f"{name}: maxdiff={onp.abs(a - b).max():.3e}"
+
+
+_MODES = {
+    "replicated": dict(shard_update=False, shard_params=False),
+    "zero1": dict(shard_update=True, shard_params=False),
+    "fsdp": dict(shard_params=True, shard_update=False),
+}
+
+
+def _make_compiled(mode, seed=21):
+    net = _make_net(seed=seed)
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3, "wd": 1e-3})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 8}), **_MODES[mode])
+    assert step.fallback_reason is None
+    return net, tr, step
+
+
+# -- resume parity (the tentpole acceptance) ---------------------------------
+@pytest.mark.parametrize("mode", sorted(_MODES))
+def test_resume_parity_bitwise(tmp_path, mode):
+    """Interrupt-at-step-4 + restore_latest() + 2 more steps is bitwise
+    identical to 6 uninterrupted steps — params AND optimizer trajectory —
+    in every residency mode."""
+    batches = [_batch(seed=s) for s in range(6)]
+
+    net_ref, tr_ref, step_ref = _make_compiled(mode)
+    for x, y in batches:
+        step_ref(x, y)
+
+    net_a, tr_a, step_a = _make_compiled(mode)
+    for x, y in batches[:4]:
+        step_a(x, y)
+    with CheckpointManager(str(tmp_path), trainer=tr_a, net=net_a,
+                           async_save=False) as mgr_a:
+        mgr_a.save(4)
+
+    # "crash": fresh objects, nothing carried over but the directory
+    net_b, tr_b, step_b = _make_compiled(mode, seed=99)  # different init
+    net_b(batches[0][0])  # settle shapes before set_data
+    with CheckpointManager(str(tmp_path), trainer=tr_b, net=net_b) as mgr_b:
+        assert mgr_b.restore_latest() == 4
+    for x, y in batches[4:]:
+        step_b(x, y)
+    assert tr_b.optimizer.num_update == tr_ref.optimizer.num_update
+    _assert_params_bitwise(net_ref, net_b)
+
+
+def test_full_state_roundtrip(tmp_path):
+    """Loss scaler, RNG (both halves), data-iterator position and extra
+    payload all ride the checkpoint."""
+    net = _make_net(seed=3)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    scaler = DynamicLossScaler(init_scale=2.0 ** 10)
+    scaler.loss_scale = 512.0
+    scaler._unskipped = 7
+    data = CheckpointableIter([_batch(seed=s) for s in range(4)])
+    next(data)
+    next(data)
+    mx.random.seed(77)
+    draw_before = mx.random.uniform(size=(3,)).asnumpy()
+
+    mgr = CheckpointManager(str(tmp_path), trainer=tr, net=net,
+                            loss_scaler=scaler, data_iter=data,
+                            async_save=False)
+    mgr.save(1, extra={"tag": "run-a"})
+
+    post_save_draw = mx.random.uniform(size=(3,)).asnumpy()
+    mx.random.seed(1234)          # clobber the RNG
+    scaler.loss_scale = 4.0       # clobber the scaler
+    scaler._unskipped = 0
+    data.load_state_dict({"epoch": 0, "offset": 0})
+
+    assert mgr.restore_latest() == 1
+    assert scaler.loss_scale == 512.0 and scaler._unskipped == 7
+    assert data.state_dict() == {"epoch": 0, "offset": 2}
+    # RNG restored to the save point: the next draw replays exactly
+    assert _bits_equal(mx.random.uniform(size=(3,)).asnumpy(),
+                       post_save_draw)
+    assert not _bits_equal(draw_before, post_save_draw)
+    mgr.close()
+
+
+def test_checkpointable_iter_fast_forward():
+    src = list(range(10))
+    it = CheckpointableIter(src)
+    assert [next(it) for _ in range(3)] == [0, 1, 2]
+    state = it.state_dict()
+    it2 = CheckpointableIter(src)
+    it2.load_state_dict(state)
+    assert next(it2) == 3
+    with pytest.raises(MXNetError):
+        CheckpointableIter([1]).load_state_dict({"epoch": 0, "offset": 5})
+
+
+# -- atomicity / validation --------------------------------------------------
+def test_retention_keeps_last_k(tmp_path):
+    net = _make_net(seed=4)
+    mgr = CheckpointManager(str(tmp_path), net=net, keep=2,
+                            async_save=False)
+    for s in (1, 2, 3, 4):
+        mgr.save(s)
+    assert mgr.steps() == [3, 4]
+    assert mgr.latest_step() == 4
+    mgr.close()
+
+
+@pytest.mark.chaos
+def test_corrupt_manifest_skipped(tmp_path):
+    """A torn manifest (chaos-simulated) invalidates only its checkpoint;
+    restore falls back to the previous valid one and counts the skip."""
+    net = _make_net(seed=5)
+    mgr = CheckpointManager(str(tmp_path), net=net, async_save=False)
+    mgr.save(1)
+    chaos.inject("ckpt.manifest.corrupt", "corrupt")
+    mgr.save(2)
+    with pytest.warns(UserWarning, match="torn/corrupt"):
+        assert mgr.latest_step() == 1
+    assert tm.REGISTRY.counter("checkpoint.corrupt_skipped").value >= 1
+    assert tm.REGISTRY.counter("fault.injected").value >= 1
+    mgr.close()
+
+
+def test_checksum_flip_detected(tmp_path):
+    """A bit flipped in a payload file after commit (disk rot, torn
+    non-atomic copy) fails checksum validation at restore."""
+    net = _make_net(seed=6)
+    mgr = CheckpointManager(str(tmp_path), net=net, async_save=False)
+    mgr.save(1)
+    mgr.save(2)
+    p = tmp_path / "step-0000000002" / "params.npz"
+    blob = bytearray(p.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    p.write_bytes(bytes(blob))
+    with pytest.warns(UserWarning, match="torn/corrupt"):
+        assert mgr.latest_step() == 1
+    mgr.close()
+
+
+def test_stale_tmp_ignored_and_gced(tmp_path):
+    """Leftover .tmp-* debris from a crashed writer is never restored from
+    and is garbage-collected by the next save."""
+    debris = tmp_path / ".tmp-step-0000000009-12345"
+    debris.mkdir()
+    (debris / "params.npz").write_bytes(b"half-written")
+    net = _make_net(seed=7)
+    mgr = CheckpointManager(str(tmp_path), net=net, async_save=False)
+    assert mgr.restore_latest() is None
+    assert mgr.steps() == []
+    mgr.save(1)
+    assert not debris.exists()
+    assert mgr.latest_step() == 1
+    mgr.close()
+
+
+def test_async_save_snapshots_at_call_time(tmp_path):
+    """The async path snapshots device state ON the save() call: mutations
+    made while the background writer runs do not leak into the file."""
+    net = _make_net(seed=8)
+    before = {n: p.data().asnumpy() for n, p in
+              net.collect_params().items()}
+    mgr = CheckpointManager(str(tmp_path), net=net, async_save=True)
+    mgr.save(1)
+    for p in net.collect_params().values():   # mutate immediately
+        p.set_data(p.data() + 1.0)
+    mgr.wait()
+    net2 = _make_net(seed=9)
+    mgr2 = CheckpointManager(str(tmp_path), net=net2)
+    assert mgr2.restore_latest() == 1
+    for n, p in net2.collect_params().items():
+        assert _bits_equal(p.data().asnumpy(), before[n]), n
+    mgr.close()
+    mgr2.close()
+
+
+def test_save_failure_flips_health_and_surfaces(tmp_path):
+    """A failing async write surfaces on wait() AND marks the manager
+    unhealthy until a later save succeeds."""
+    net = _make_net(seed=10)
+    mgr = CheckpointManager(str(tmp_path), net=net, async_save=True)
+    chaos.inject("ckpt.write.begin", "raise")
+    mgr.save(1)
+    with pytest.raises(chaos.FaultError):
+        mgr.wait()
+    assert not mgr.healthy
+    checks = tm.health_checks()
+    name = f"checkpoint:{mgr.directory}"
+    assert checks[name]["ok"] is False
+    assert tm.REGISTRY.counter("checkpoint.failures").value == 1
+    mgr.save(2, block=True)  # recovery clears the health flag
+    assert mgr.healthy
+    mgr.close()
+
+
+# -- kill -9 matrix (subprocess) ---------------------------------------------
+_CHILD_TRAIN = r"""
+import os
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.checkpoint import CheckpointManager
+
+mx.random.seed(3)
+net = nn.Dense(4, in_units=3)
+net.initialize()
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+
+def step():
+    x = mx.random.uniform(size=(2, 3))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+
+m = CheckpointManager(os.environ["CKPT_DIR"], trainer=tr, net=net,
+                      async_save=False)
+step(); m.save(1)
+step(); m.save(2)   # an armed MXTPU_FAULT_CKPT_* die point fires in here
+print("SURVIVED", flush=True)
+"""
+
+
+@pytest.mark.chaos
+@pytest.mark.integration
+@pytest.mark.parametrize("point,expect_step", [
+    ("ckpt.write.begin", 1),
+    ("ckpt.write.arrays", 1),
+    ("ckpt.write.manifest", 1),
+    ("ckpt.write.rename", 2),   # rename already committed: step 2 is valid
+])
+def test_kill9_during_save_always_restores_valid(tmp_path, point,
+                                                 expect_step):
+    """SIGKILL the process at each stage of the commit protocol (second
+    save); the directory must always contain a valid checkpoint — step 1
+    before the rename, step 2 after it — and never a trusted torn one."""
+    env = dict(os.environ)
+    env["CKPT_DIR"] = str(tmp_path)
+    env[chaos.env_name(point)] = "die:1"  # skip save(1)'s hit, die in save(2)
+    proc = subprocess.run([sys.executable, "-c", _CHILD_TRAIN], env=env,
+                          cwd=ROOT, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == -signal.SIGKILL, \
+        (proc.returncode, proc.stdout, proc.stderr)
+    assert "SURVIVED" not in proc.stdout
+
+    mgr = CheckpointManager(str(tmp_path))
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # torn debris may warn; that's fine
+        assert mgr.latest_step() == expect_step
+    mgr.close()
+
+
+# -- preemption --------------------------------------------------------------
+@pytest.mark.chaos
+def test_run_preemptible_simulated(tmp_path):
+    """Simulated preemption (chaos flag) after 3 polls: the in-flight step
+    finishes, a final checkpoint commits, and a rerun resumes after it."""
+    net = _make_net(seed=11)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    done = []
+
+    def step_fn(step):
+        x, y = _batch(seed=step)
+        from mxnet_tpu import autograd
+        with autograd.record():
+            loss = gluon.loss.SoftmaxCrossEntropyLoss()(net(x), y).mean()
+        loss.backward()
+        tr.step(1)
+        done.append(step)
+
+    mgr = CheckpointManager(str(tmp_path), trainer=tr, net=net,
+                            async_save=False)
+    chaos.inject("preempt.step", "flag", countdown=2, times=1)
+    last, preempted = run_preemptible(step_fn, 10, mgr)
+    assert preempted and last == 3 and done == [1, 2, 3]
+    assert mgr.latest_step() == 3
+
+    # restart: resumes AFTER the preemption checkpoint, finishes the run
+    last2, preempted2 = run_preemptible(step_fn, 5, mgr)
+    assert (last2, preempted2) == (5, False)
+    assert done == [1, 2, 3, 4, 5]
+    mgr.close()
+
+
+_CHILD_PREEMPT = r"""
+import os, time
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.checkpoint import CheckpointManager, run_preemptible
+
+mx.random.seed(3)
+net = nn.Dense(4, in_units=3)
+net.initialize()
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+
+def step_fn(step):
+    x = mx.random.uniform(size=(2, 3))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+    time.sleep(0.05)
+
+m = CheckpointManager(os.environ["CKPT_DIR"], trainer=tr, net=net,
+                      async_save=False)
+print("READY", flush=True)
+last, preempted = run_preemptible(step_fn, 100000, m, save_every=5)
+print(f"DONE last={last} preempted={preempted}", flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_sigterm_finishes_step_saves_and_exits(tmp_path):
+    """Real SIGTERM mid-run: the child finishes its in-flight step, commits
+    a final checkpoint, and exits cleanly (auto-resume contract)."""
+    env = dict(os.environ)
+    env["CKPT_DIR"] = str(tmp_path)
+    proc = subprocess.Popen([sys.executable, "-c", _CHILD_PREEMPT], env=env,
+                            cwd=ROOT, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        time.sleep(1.0)  # let a few steps run
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, (proc.returncode, out, err)
+    assert "preempted=True" in out, (out, err)
+    last = int(out.split("last=")[1].split()[0])
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() == last  # the finish-then-save checkpoint
+    mgr.close()
+
+
+# -- bench smoke -------------------------------------------------------------
+def test_bench_checkpoint_smoke(monkeypatch):
+    """bench.py checkpoint (small): runs all three regimes and reports the
+    async p99 inflation + per-regime stall percentiles."""
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(ROOT)
+
+    monkeypatch.setenv("BENCH_CHECKPOINT_SMALL", "1")
+    r = bench.bench_checkpoint()
+    assert r["unit"] == "%"
+    assert r["steps"] == 12
+    assert r["no_ckpt"]["p99_ms"] > 0
+    assert r["sync_save"]["stall_ms_p99"] is not None
+    assert r["async_save"]["stall_ms_p99"] is not None
+    assert isinstance(r["async_under_10pct"], bool)

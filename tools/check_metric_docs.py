@@ -1,7 +1,8 @@
 #!/usr/bin/env python3
-"""Lint: every ``serve.*`` / ``telemetry.*`` metric name created anywhere
-in ``mxnet_tpu/`` must appear in docs/DESIGN.md (the Observability metric
-inventory), so the exported namespace and the documentation cannot drift.
+"""Lint: every ``serve.*`` / ``telemetry.*`` / ``checkpoint.*`` /
+``fault.*`` metric name created anywhere in ``mxnet_tpu/`` must appear in
+docs/DESIGN.md (the Observability metric inventory), so the exported
+namespace and the documentation cannot drift.
 
 Literal names must appear verbatim; f-string names (dynamic buckets like
 ``serve.bucket{bucket}.call``) are checked by their literal prefix up to
@@ -18,11 +19,11 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 DESIGN = ROOT / "docs" / "DESIGN.md"
 
 # any Registry accessor or direct metric-class construction carrying a
-# serve./telemetry. name, e.g. REGISTRY.counter("serve.requests") or
+# name in a linted namespace, e.g. REGISTRY.counter("serve.requests") or
 # Histogram("serve.ttft_ms", ...)
 _CREATE = re.compile(
     r"(?:counter|gauge|timer|histogram|Counter|Gauge|Timer|Histogram)\(\s*"
-    r"(f?)([\"'])((?:serve|telemetry)\.[^\"']*)\2")
+    r"(f?)([\"'])((?:serve|telemetry|checkpoint|fault)\.[^\"']*)\2")
 
 
 def collect(src_root=None):
@@ -53,8 +54,9 @@ def missing_names(doc_path=DESIGN, src_root=None):
 def main():
     missing = missing_names()
     if not missing:
-        print(f"metric docs lint: all {len(collect())} serve./telemetry. "
-              "names documented in docs/DESIGN.md")
+        print(f"metric docs lint: all {len(collect())} "
+              "serve./telemetry./checkpoint./fault. names documented in "
+              "docs/DESIGN.md")
         return 0
     print("metric names missing from docs/DESIGN.md:", file=sys.stderr)
     for name, sites in sorted(missing.items()):
