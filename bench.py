@@ -5,7 +5,8 @@ Default metric mirrors the reference's headline benchmark
 V100 fp16 ResNet-50 batch 128: 2355.04 img/s, BASELINE.md). Select with
 argv[1] or BENCH env: resnet (default) | resnet_train | train_step |
 train_step_sharded (or ``train_step --shard-update``) |
-train_step_fsdp (or ``train_step --shard-params``) | lstm_lm |
+train_step_fsdp (or ``train_step --shard-params``) |
+train_step_multi (or ``train_step --multi-step K``) | lstm_lm |
 bert_pretrain | bert_large_pretrain | optimizer_step |
 telemetry_overhead | serve | serve_llm | checkpoint.
 
@@ -426,6 +427,97 @@ def bench_train_step_fsdp():
             "dispatches_per_step": disp,
             "recompiles_after_warmup": recomp,
             "compiled_programs": step_f._traces,
+            "mfu": None}
+
+
+def bench_train_step_multi():
+    """Scanned super-step execution (``compile_step(multi_step=K)``): K
+    optimizer steps per dispatch via ``lax.scan``, fed by a
+    ``DevicePrefetcher`` that stacks + stages the next super-batch while
+    the current one computes. Sweeps K over {1, 4, 16} on the dp mesh and
+    reports steps/s, HOST ms per step (dispatch-side cost, the quantity
+    the scan amortizes — device compute per step is constant on a host
+    mesh) and dispatches/step (1/K). K=1 runs through the same scanned
+    machinery, so the sweep isolates the super-step amortization. Select
+    with ``bench.py train_step --multi-step K`` (K = the headline row;
+    every swept K lands in ``sweep``). BENCH_TRAIN_STEP_SMALL=1 shrinks
+    the model/iterations for the not-slow suite."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.data import DevicePrefetcher
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    small = os.environ.get("BENCH_TRAIN_STEP_SMALL", "") == "1"
+    B, H, WARMUP, ITERS = (32, 64, 1, 4) if small else (64, 256, 2, 12)
+    ks = [1, 4] if small else [1, 4, 16]
+    want_k = int(os.environ.get("BENCH_MULTI_STEP", "0")) or ks[-1]
+    if want_k not in ks:
+        ks.append(want_k)
+    mesh = make_mesh()
+    n_dp = mesh.shape["dp"]
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(0)
+    x_np = rs.standard_normal((B, H)).astype("float32")
+    y_np = rs.randint(0, 10, (B,)).astype("float32")
+
+    def run_k(k):
+        mx.random.seed(7)
+        net = nn.Sequential()
+        net.add(nn.Dense(H, activation="relu"), nn.BatchNorm(),
+                nn.Dense(H, activation="relu"), nn.Dense(10))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9})
+        step = tr.compile_step(net, loss_fn, mesh=mesh, multi_step=k)
+        batches = [(x_np, y_np)] * (k * (WARMUP + ITERS))
+        pf = DevicePrefetcher(batches, multi_step=k)
+        it = iter(pf)
+        # telemetry stays ON for the whole sweep leg: the host-ms gauge
+        # and super-step rows are the measurement (same overhead at
+        # every K, so the ratios are clean)
+        telemetry.reset()
+        for _ in range(WARMUP):
+            _sync(step(*next(it))._data)
+        c0 = telemetry.compile_count()
+        host_ms = []
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = step(*next(it))
+            host_ms.append(telemetry.gauge("train.host_ms_per_step").value)
+        _sync(loss._data)
+        dt = time.perf_counter() - t0
+        pf.close()
+        row = telemetry.last_step() or {}
+        return {"steps_per_sec": round(k * ITERS / dt, 2),
+                "host_ms_per_step": round(sum(host_ms) / len(host_ms), 4),
+                "dispatches_per_step":
+                    round(row.get("dispatches_per_step", -1), 4),
+                "recompiles_after_warmup":
+                    telemetry.compile_count() - c0,
+                "compiled_programs": step._traces}
+
+    was_on = telemetry.is_enabled()
+    telemetry.enable()
+    try:
+        sweep = {str(k): run_k(k) for k in ks}
+    finally:
+        telemetry.enable() if was_on else telemetry.disable()
+    head = sweep[str(want_k)]
+    base = sweep[str(ks[0])]
+    return {"metric": f"train_step_multi_step_k{want_k}",
+            "value": head["steps_per_sec"], "unit": "steps/s",
+            "vs_baseline": round(head["steps_per_sec"] /
+                                 max(base["steps_per_sec"], 1e-9), 3),
+            "host_ms_per_step": head["host_ms_per_step"],
+            "host_ms_speedup_vs_k1":
+                round(base["host_ms_per_step"] /
+                      max(head["host_ms_per_step"], 1e-9), 2),
+            "dispatches_per_step": head["dispatches_per_step"],
+            "recompiles_after_warmup": head["recompiles_after_warmup"],
+            "dp_size": int(n_dp),
+            "sweep": sweep,
             "mfu": None}
 
 
@@ -1092,6 +1184,11 @@ def main():
         which = "train_step_sharded"
     if which == "train_step" and "--shard-params" in sys.argv[2:]:
         which = "train_step_fsdp"
+    if which == "train_step" and "--multi-step" in sys.argv[2:]:
+        which = "train_step_multi"
+        i = sys.argv.index("--multi-step")
+        if len(sys.argv) > i + 1 and sys.argv[i + 1].isdigit():
+            os.environ["BENCH_MULTI_STEP"] = sys.argv[i + 1]
     import functools
 
     result = {"metric": which, "value": 0.0, "unit": "",
@@ -1102,6 +1199,7 @@ def main():
               "train_step": bench_train_step,
               "train_step_sharded": bench_train_step_sharded,
               "train_step_fsdp": bench_train_step_fsdp,
+              "train_step_multi": bench_train_step_multi,
               "lstm_lm": bench_lstm_lm,
               "bert_pretrain": bench_bert_pretrain,
               "bert_large_pretrain": functools.partial(bench_bert_pretrain,

@@ -26,6 +26,20 @@ class LossScaler:
     def update_scale(self, overflow: bool):
         pass
 
+    def replay(self, flags):
+        """Apply a sequence of per-step overflow flags in order (the K
+        inner steps of one scanned super-step run before the host can see
+        any flag; the scale itself was one program operand for the whole
+        super-step, which is exact because power-of-two scales cancel
+        against the in-program rescale). Returns the clean-step count."""
+        clean = 0
+        for f in flags:
+            f = bool(f)
+            self.update_scale(f)
+            if not f:
+                clean += 1
+        return clean
+
 
 class StaticLossScaler(LossScaler):
     pass

@@ -151,10 +151,20 @@ class CheckpointableIter:
     run had not consumed. Deterministic sources (no reshuffle across
     processes) make the fast-forward exact; that is the same contract
     ``mx.random.seed`` restoration relies on.
+
+    Position-tracking sources (anything exposing ``state_dict`` /
+    ``load_state_dict``, e.g. ``gluon.data.DevicePrefetcher``) are
+    DELEGATED to instead of counted: a prefetcher stages batches ahead of
+    the training loop, so this wrapper's own next()-counting would record
+    staged positions — the source's counter reflects batches actually
+    consumed (and the prefetcher's resume skips on its source, not on
+    device-staged groups).
     """
 
     def __init__(self, source):
         self._source = source
+        self._delegate = (hasattr(source, "state_dict") and
+                          hasattr(source, "load_state_dict"))
         self._it = None
         self.epoch = 0
         self.offset = 0
@@ -164,7 +174,7 @@ class CheckpointableIter:
 
     def __next__(self):
         if self._it is None:
-            if hasattr(self._source, "reset"):
+            if not self._delegate and hasattr(self._source, "reset"):
                 self._source.reset()
             self._it = iter(self._source)
         try:
@@ -178,9 +188,15 @@ class CheckpointableIter:
         return batch
 
     def state_dict(self):
+        if self._delegate:
+            return self._source.state_dict()
         return {"epoch": self.epoch, "offset": self.offset}
 
     def load_state_dict(self, state):
+        if self._delegate:
+            self._source.load_state_dict(state)
+            self._it = None
+            return
         self.epoch = int(state["epoch"])
         self.offset = 0
         self._it = None

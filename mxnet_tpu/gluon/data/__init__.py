@@ -4,10 +4,11 @@ from .dataset import (Dataset, SimpleDataset, ArrayDataset,
 from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
                       IntervalSampler, FilterSampler)
 from .dataloader import DataLoader, default_batchify_fn
+from .prefetcher import DevicePrefetcher
 from . import batchify
 from . import vision
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset", "Sampler",
            "SequentialSampler", "RandomSampler", "BatchSampler",
            "IntervalSampler", "FilterSampler", "DataLoader",
-           "default_batchify_fn", "batchify", "vision"]
+           "DevicePrefetcher", "default_batchify_fn", "batchify", "vision"]

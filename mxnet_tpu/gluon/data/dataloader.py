@@ -211,6 +211,16 @@ class DataLoader:
     def __len__(self):
         return len(self._batch_sampler)
 
+    def device_prefetch(self, multi_step=None, depth=None, sharding=None):
+        """Wrap this loader in a :class:`DevicePrefetcher`: stack groups
+        of ``multi_step`` batches into the ``[K, batch, ...]`` super-
+        batches the scanned train step consumes and overlap their H2D
+        transfer with the previous super-step's compute."""
+        from .prefetcher import DevicePrefetcher
+
+        return DevicePrefetcher(self, multi_step=multi_step, depth=depth,
+                                sharding=sharding)
+
     def _load_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 

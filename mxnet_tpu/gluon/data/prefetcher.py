@@ -1,0 +1,249 @@
+"""DevicePrefetcher: async device-resident staging for the compiled step.
+
+Generalizes the serving batcher's double-buffering into the training input
+pipeline: a worker thread pulls host batches from any batch source (a
+``DataLoader``, an ``io.DataIter``, a list), stacks groups of
+``multi_step=K`` of them into the ``[K, batch, ...]`` super-batches the
+scanned train step consumes, and ships each group to the device with
+``jax.device_put`` while the PREVIOUS super-step is still computing — H2D
+of super-step k+1 overlaps compute of super-step k, and the host never
+blocks on a transfer at dispatch time.
+
+Checkpoint position contract: ``state_dict()`` reports batches CONSUMED
+(yielded to the training loop), never batches the worker has merely
+staged — a resume replays exactly the batches whose updates were not
+committed. Compose as ``CheckpointableIter(DevicePrefetcher(loader))``
+(or hand it straight to ``CheckpointManager(data_iter=...)``); wrapping a
+``CheckpointableIter`` INSIDE the prefetcher would count staged batches
+and over-advance on resume.
+
+A shorter trailing group at epoch end is staged with its natural leading
+extent — the step callable compiles one extra program for it, reused
+every epoch, so steady state stays at zero recompiles.
+
+Fault injection: the worker declares ``chaos.fault_point("prefetch.stage")``
+per staged group; an armed fault surfaces on the consumer as a clean
+``MXNetError`` for the epoch instead of a hung queue.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+import numpy as onp
+
+from ...base import MXNetError, warn_once
+
+__all__ = ["DevicePrefetcher"]
+
+_POLL_S = 0.1  # consumer/producer wakeup granularity (stop + death checks)
+
+
+def _leaves(batch):
+    """Normalize one source batch to a tuple of host numpy leaves."""
+    from ...io import DataBatch
+
+    if isinstance(batch, DataBatch):
+        parts = list(batch.data) + list(batch.label)
+    elif isinstance(batch, (tuple, list)):
+        parts = list(batch)
+    else:
+        parts = [batch]
+    return tuple(
+        onp.asarray(p._data) if hasattr(p, "_data") else onp.asarray(p)
+        for p in parts)
+
+
+class DevicePrefetcher:
+    """Stack + stage batches on device ahead of the training loop.
+
+    Parameters
+    ----------
+    source : iterable of batches
+        Re-iterable batch source: ``DataLoader``, ``io.DataIter`` (its
+        ``reset()`` is called at each epoch start), list of batches, ...
+    multi_step : int or None
+        Group size K: yield ``[K, batch, ...]``-stacked device arrays for
+        ``compile_step(multi_step=K)``. ``None`` stages single batches
+        (pure H2D overlap, no stacking).
+    depth : int or None
+        Staging queue depth (groups in flight). Default
+        ``MXTPU_PREFETCH_DEPTH`` or 2 — one group computing, one staged.
+    sharding : jax sharding or None
+        Passed to ``jax.device_put`` for each staged leaf (e.g. a
+        ``NamedSharding`` laying the batch axis over 'dp').
+    timeout : float
+        Seconds the consumer waits on the staging queue before declaring
+        the worker wedged (clean error, never a silent hang).
+    """
+
+    def __init__(self, source, multi_step=None, depth=None, sharding=None,
+                 timeout=60.0):
+        if multi_step is not None:
+            multi_step = int(multi_step)
+            if multi_step < 1:
+                raise MXNetError(
+                    f"multi_step must be >= 1, got {multi_step}")
+        if depth is None:
+            depth = int(os.environ.get("MXTPU_PREFETCH_DEPTH", "2"))
+        if depth < 1:
+            raise MXNetError(f"prefetch depth must be >= 1, got {depth}")
+        if hasattr(source, "state_dict"):
+            warn_once(("device_prefetch_order", id(source)),
+                      "DevicePrefetcher wraps a position-tracking source: "
+                      "its counter will see STAGED batches, not consumed "
+                      "ones. Compose the other way around: "
+                      "CheckpointableIter(DevicePrefetcher(loader))",
+                      RuntimeWarning)
+        self._source = source
+        self._k = multi_step
+        self._depth = depth
+        self._sharding = sharding
+        self._timeout = float(timeout)
+        self.epoch = 0
+        self.offset = 0          # SOURCE batches consumed this epoch
+        self._pending_skip = 0   # resume fast-forward, applied at epoch start
+        self._q = None
+        self._stop = threading.Event()
+        self._worker_t = None
+
+    # -- iteration ----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._worker_t is None:
+            self._start_epoch()
+        waited = 0.0
+        while True:
+            try:
+                item = self._q.get(timeout=_POLL_S)
+                break
+            except queue.Empty:
+                if not self._worker_t.is_alive():
+                    # died without reporting (e.g. killed mid-stage):
+                    # fail the epoch instead of hanging the scan feed
+                    self._worker_t = None
+                    raise MXNetError(
+                        "DevicePrefetcher worker died without staging a "
+                        "batch or closing the epoch")
+                waited += _POLL_S
+                if waited >= self._timeout:
+                    raise MXNetError(
+                        f"DevicePrefetcher stalled: no batch staged in "
+                        f"{self._timeout:.0f}s (source wedged?)")
+        tag = item[0]
+        if tag == "batch":
+            _, arrays, n_src = item
+            self.offset += n_src
+            return arrays
+        self._join_worker()
+        if tag == "end":
+            self.epoch += 1
+            self.offset = 0
+            raise StopIteration
+        raise item[1]  # "err": the worker's exception, on the consumer
+
+    def state_dict(self):
+        """Consumed position only — staged-ahead batches are NOT counted
+        (they will be re-staged by the resumed run)."""
+        return {"epoch": self.epoch, "offset": self.offset}
+
+    def load_state_dict(self, state):
+        self.close()
+        self.epoch = int(state["epoch"])
+        self.offset = 0
+        self._pending_skip = int(state["offset"])
+
+    def close(self):
+        """Stop the worker and drop staged batches (idempotent)."""
+        self._stop.set()
+        self._join_worker()
+        self._q = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    # -- epoch / worker machinery -------------------------------------------
+    def _start_epoch(self):
+        src = self._source
+        if hasattr(src, "reset"):
+            src.reset()
+        it = iter(src)
+        # resume fast-forward runs on THIS thread so a failure surfaces
+        # synchronously at the load site, not as a worker error later
+        skip = self._pending_skip
+        for n in range(skip):
+            try:
+                next(it)
+            except StopIteration:
+                raise MXNetError(
+                    "cannot fast-forward data source to offset "
+                    f"{skip}: exhausted at {n}") from None
+        self._pending_skip = 0
+        self.offset = skip
+        self._q = queue.Queue(self._depth)
+        self._stop = threading.Event()
+        t = threading.Thread(target=self._worker, args=(it,),
+                             name="DevicePrefetcher", daemon=True)
+        t.start()
+        self._worker_t = t
+
+    def _join_worker(self):
+        t = self._worker_t
+        self._worker_t = None
+        if t is not None and t.is_alive():
+            self._stop.set()
+            t.join(timeout=5.0)
+
+    def _worker(self, it):
+        try:
+            group = []
+            for batch in it:
+                if self._stop.is_set():
+                    return
+                leaves = _leaves(batch)
+                if group and [l.shape for l in leaves] != \
+                        [l.shape for l in group[0]]:
+                    # ragged batch (e.g. last_batch='keep'): close the
+                    # group early so every stack stays rectangular
+                    self._stage(group)
+                    group = []
+                group.append(leaves)
+                if len(group) >= (self._k or 1):
+                    self._stage(group)
+                    group = []
+            if group:
+                self._stage(group)
+            self._put(("end",))
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            self._put(("err", e))
+
+    def _stage(self, group):
+        import jax
+
+        from ...ndarray.ndarray import NDArray
+        from ...testing import chaos
+
+        chaos.fault_point("prefetch.stage")
+        if self._k is None:
+            host = group[0]
+        else:
+            host = tuple(onp.stack(col) for col in zip(*group))
+        arrays = tuple(
+            NDArray(jax.device_put(h, self._sharding) if self._sharding
+                    is not None else jax.device_put(h))
+            for h in host)
+        self._put(("batch", arrays, len(group)))
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=_POLL_S)
+                return
+            except queue.Full:
+                continue

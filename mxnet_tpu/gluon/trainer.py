@@ -80,7 +80,8 @@ class Trainer:
     # -- whole-step compilation ---------------------------------------------
     def compile_step(self, net, loss_fn, mesh=None, loss_scaler=None,
                      shard_update=None, strict_batch=False,
-                     shard_params=None, partition_rules=None):
+                     shard_params=None, partition_rules=None,
+                     multi_step=None, accumulate=None):
         """Compile forward + loss + backward (+ mesh allreduce) + update into
         ONE donated-buffer program; returns the CompiledTrainStep, also
         exposed as ``self.step_fn``. Semantics of the compiled callable match
@@ -108,13 +109,30 @@ class Trainer:
         ``(regex, PartitionSpec)`` pairs over parameter names (default
         ``parallel.partition.fsdp_rules()``) — decide which trainables
         shard; scalar leaves always replicate. FSDP supersedes
-        ``shard_update``. See docs/DESIGN.md "Full-parameter sharding"."""
+        ``shard_update``. See docs/DESIGN.md "Full-parameter sharding".
+
+        ``multi_step=K`` switches the callable to scanned SUPER-step
+        execution: one ``lax.scan`` program advances K optimizer steps per
+        dispatch over inputs stacked ``[K, batch, ...]`` (pair with
+        ``DataLoader.device_prefetch(multi_step=K)``); ``accumulate=G``
+        sums gradients over G stacked microbatches before each update.
+        ``MXTPU_MULTI_STEP`` overrides ``multi_step`` from the environment
+        (``0`` disables). See docs/DESIGN.md "Multi-step execution"."""
         from ..train_step import CompiledTrainStep
 
         self._compiled_step = CompiledTrainStep(
             self, net, loss_fn, mesh=mesh, loss_scaler=loss_scaler,
             shard_update=shard_update, strict_batch=strict_batch,
             shard_params=shard_params, partition_rules=partition_rules)
+        env = os.environ.get("MXTPU_MULTI_STEP")
+        if env is not None:
+            env = env.strip()
+            multi_step = int(env) if env else None
+            if multi_step is not None and multi_step < 1:
+                multi_step = None  # 0 disables any coded-in default
+        if multi_step is not None or (accumulate or 1) > 1:
+            self._compiled_step.compile_multi_step(
+                multi_step, accumulate=accumulate or 1)
         return self._compiled_step
 
     @property

@@ -107,6 +107,16 @@ class DataIter:
     def getindex(self):
         return None
 
+    def device_prefetch(self, multi_step=None, depth=None, sharding=None):
+        """Wrap this iterator in a ``gluon.data.DevicePrefetcher``: stack
+        groups of ``multi_step`` batches into ``[K, batch, ...]`` super-
+        batches on device, overlapping H2D with the previous super-step's
+        compute. ``reset()`` is driven by the prefetcher at epoch starts."""
+        from ..gluon.data.prefetcher import DevicePrefetcher
+
+        return DevicePrefetcher(self, multi_step=multi_step, depth=depth,
+                                sharding=sharding)
+
     def getpad(self):
         return 0
 

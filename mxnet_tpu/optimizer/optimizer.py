@@ -115,6 +115,24 @@ class Optimizer:
         counts = [self._index_update_count.get(i, 0) + 1 for i in indices]
         return counts, max([self.num_update] + counts)
 
+    def _staged_counts_k(self, indices, k):
+        """``_staged_counts`` for a K-step scanned super-step: row ``j`` is
+        the counts/num_update the j-th COMMITTED inner step would see —
+        exactly what K sequential stage/commit rounds produce. The program
+        indexes the rows by its in-scan committed counter, so an overflow-
+        skipped inner step re-reads its row, just as the eager loop re-
+        stages the same count after a skip. Non-mutating. Returns
+        ``(rows, num_updates)``, each of length ``k``."""
+        base = {i: self._index_update_count.get(i, 0) for i in indices}
+        nu = self.num_update
+        rows, nus = [], []
+        for j in range(k):
+            counts = [base[i] + j + 1 for i in indices]
+            rows.append(counts)
+            nus.append(max([nu] + counts))
+            nu = max(nu, max(counts))
+        return rows, nus
+
     def _commit_counts(self, indices):
         """Apply the counts previously staged by ``_staged_counts``."""
         for i in indices:

@@ -181,12 +181,13 @@ def _maybe_span(name, wall_ts, dur):
 
 
 # -- steps ------------------------------------------------------------------
-def mark_step(name=None):
+def mark_step(name=None, inner_steps=1):
     """Close one accounting step (no-op when disabled). Trainer calls this
-    at the end of every ``step()``/``update()``."""
+    at the end of every ``step()``/``update()``; the scanned super-step
+    passes ``inner_steps=K`` so the row carries per-inner-step averages."""
     if not ON:
         return None
-    return STEPS.mark_step(name, event_log=EVENTS)
+    return STEPS.mark_step(name, event_log=EVENTS, inner_steps=inner_steps)
 
 
 def step_report(reset=False):

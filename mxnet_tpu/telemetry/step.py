@@ -65,7 +65,13 @@ class StepTracker:
         self._timers = [m for m in reg if isinstance(m, Timer)]
         self._seen_version = reg.version
 
-    def mark_step(self, name=None, event_log=None):
+    def mark_step(self, name=None, event_log=None, inner_steps=1):
+        """Close one accounting row. ``inner_steps=K`` marks a SUPER-step
+        (one scanned dispatch covering K optimizer steps): the row's
+        counter deltas span all K, ``dispatches_per_step`` becomes the
+        K-amortized float (< 1 in steady state) and ``per_step`` carries
+        the per-inner-step averages; the step index advances by K."""
+        inner_steps = max(1, int(inner_steps))
         with self._lock:
             if self._seen_version != self._registry.version:
                 self._refresh_cache()
@@ -82,6 +88,8 @@ class StepTracker:
             row["collective_bytes"] = (row["reduce_scatter_bytes"] +
                                        row["all_gather_bytes"] +
                                        row["psum_bytes"])
+            row["inner_steps"] = inner_steps
+            row["dispatches_per_step"] = row["dispatches"] / inner_steps
             # MFU over the step interval: flops credited since the last
             # mark against wall time x device peak. None on the first row
             # (no interval yet) or without a known peak (CPU unless
@@ -106,13 +114,20 @@ class StepTracker:
                     host[t.name] = d
                 prev[key] = tot
             row["host_time"] = host
+            if inner_steps > 1:
+                per = {col: row[col] / inner_steps
+                       for col, _ in self._cols}
+                if dt is not None:
+                    per["step_time_s"] = dt / inner_steps
+                row["per_step"] = per
             self._rows.append(row)
-            self._steps += 1
+            self._steps += inner_steps
         if event_log is not None:
             event_log.emit("step", kind="counter", ts=row["wall_time"],
                            step_name=row["name"],
                            **{k: v for k, v in row.items()
-                              if k not in ("wall_time", "host_time", "name")})
+                              if k not in ("wall_time", "host_time", "name",
+                                           "per_step")})
         return row
 
     def report(self, reset=False):

@@ -108,10 +108,10 @@ class _Program:
 
     __slots__ = ("fn", "uses_rng", "aux_targets", "n_aux", "sharded",
                  "fsdp", "coll_bytes", "compiled", "flops",
-                 "bytes_accessed")
+                 "bytes_accessed", "k", "accum")
 
     def __init__(self, fn, uses_rng, aux_targets, sharded=False, fsdp=False,
-                 coll_bytes=(0, 0, 0)):
+                 coll_bytes=(0, 0, 0), k=None, accum=1):
         self.fn = fn
         self.uses_rng = uses_rng
         self.aux_targets = aux_targets
@@ -127,6 +127,10 @@ class _Program:
         self.compiled = None
         self.flops = 0.0
         self.bytes_accessed = 0.0
+        # multi-step super-step shape: k scanned optimizer steps, each
+        # accumulating `accum` microbatches; k=None is the single-step path
+        self.k = k
+        self.accum = accum
 
 
 class _ShardedOptState:
@@ -540,6 +544,8 @@ class CompiledTrainStep:
         self._state_bucket_bytes = 0
         self._traces = 0       # trace-time count (observes recompiles)
         self._dispatches = 0   # compiled-program calls
+        self.multi_step = None  # K scanned steps per dispatch (None = off)
+        self.accumulate = 1     # microbatches psum'd per optimizer step
         self._check_supported()
         self._resolve_shard_params(shard_params)
         self._resolve_shard_update(shard_update)
@@ -647,8 +653,56 @@ class CompiledTrainStep:
                   f"compile_step: sharded weight update unavailable — "
                   f"{reason}; keeping the replicated update", RuntimeWarning)
 
+    # -- multi-step configuration -------------------------------------------
+    def compile_multi_step(self, multi_step, accumulate=1):
+        """Switch this step to scanned super-step execution: ONE donated-
+        buffer program ``lax.scan``s the whole step body over K stacked
+        microbatches (``multi_step=K``), and/or accumulates gradients over
+        G microbatches before each optimizer update (``accumulate=G``).
+
+        The callable then takes STACKED inputs: ``[K, B, ...]`` with
+        ``multi_step=K`` alone, ``[G, B, ...]`` with ``accumulate=G``
+        alone, ``[K, G, B, ...]`` with both. ``multi_step`` is the nominal
+        K — any leading extent compiles its own program (a shorter
+        trailing group at epoch end reuses its program every epoch, so
+        steady state stays at zero recompiles). Per-inner-step hypers
+        (t/lr/wd) ride as a ``[K, n]`` runtime table indexed in-scan by
+        the committed-step counter, so LR schedules advance per inner
+        step with zero recompiles and an overflow-skipped inner step
+        leaves the schedule untouched — exactly the eager skip. The loss
+        scale itself is one runtime operand per super-step: the host
+        replays the K per-inner-step overflow flags through
+        ``LossScaler.replay`` at the super-step boundary (scale changes
+        take effect at the next super-step; the applied update is
+        identical because power-of-two scales cancel exactly against
+        ``rescale``). Returns ``self``.
+
+        Semantics match K sequential single-step dispatches bitwise for
+        the replicated and ZeRO-1 residencies (same body, same bits) and
+        to tight tolerance for FSDP (structurally different program).
+        Batches must divide the dp extent exactly — the in-program pad
+        path is per-signature and has no stacked analogue."""
+        if multi_step is not None:
+            multi_step = int(multi_step)
+            if multi_step < 1:
+                raise MXNetError(
+                    f"multi_step must be >= 1, got {multi_step}")
+        accumulate = int(accumulate)
+        if accumulate < 1:
+            raise MXNetError(f"accumulate must be >= 1, got {accumulate}")
+        if self.fallback_reason is not None:
+            raise MXNetError(
+                "compile_multi_step: the step cannot compile "
+                f"({self.fallback_reason}) and a stacked super-batch has "
+                "no eager fallback")
+        self.multi_step = multi_step
+        self.accumulate = accumulate
+        return self
+
     # -- stepping -----------------------------------------------------------
     def __call__(self, x, y):
+        if self.multi_step is not None or self.accumulate > 1:
+            return self._call_multi(x, y)
         if self.fallback_reason is not None:
             return self._eager_step(x, y)
         pad = self._validate_batch(x)
@@ -660,6 +714,66 @@ class CompiledTrainStep:
                 return self._eager_step(x, y)
             self._cache[sig] = prog
         return self._run(prog, x, y)
+
+    def _call_multi(self, x, y):
+        if self.fallback_reason is not None:
+            raise MXNetError(
+                "multi-step dispatch cannot fall back to the eager loop "
+                f"(stacked inputs): {self.fallback_reason}")
+        k, x, y = self._split_super(x, y)
+        g = self.accumulate
+        sig = ("multi", g, x.shape, str(x.dtype), y.shape, str(y.dtype))
+        prog = self._cache.get(sig)
+        if prog is None:
+            prog = self._build(x, y, pad=0, k=k, g=g)
+            if prog is None:
+                raise MXNetError(
+                    "multi-step dispatch cannot fall back to the eager "
+                    f"loop (stacked inputs): {self.fallback_reason}")
+            self._cache[sig] = prog
+        return self._run_multi(prog, x, y)
+
+    def _split_super(self, x, y):
+        """Validate the stacked super-batch layout; returns ``(k, x, y)``
+        with inputs normalized to a leading step axis (accumulate-only
+        calls gain a length-1 one)."""
+        from .ndarray.ndarray import NDArray
+
+        g = self.accumulate
+        lead = 2 if g > 1 else 1
+        if self.multi_step is None:
+            # accumulate-only: [G, B, ...] -> [1, G, B, ...]
+            if x.ndim < 2 or x.shape[0] != g:
+                raise MXNetError(
+                    f"accumulate={g} expects inputs stacked [G, batch, "
+                    f"...]; got x of shape {tuple(x.shape)}")
+            x = NDArray(x._data[None])
+            y = NDArray(y._data[None])
+        elif g > 1:
+            if x.ndim < 3 or x.shape[1] != g:
+                raise MXNetError(
+                    f"multi_step with accumulate={g} expects inputs "
+                    f"stacked [K, G, batch, ...]; got x of shape "
+                    f"{tuple(x.shape)}")
+        elif x.ndim < 2:
+            raise MXNetError(
+                "multi_step expects inputs stacked [K, batch, ...]; got "
+                f"x of shape {tuple(x.shape)}")
+        k = int(x.shape[0])
+        if tuple(y.shape[:lead]) != tuple(x.shape[:lead]):
+            raise MXNetError(
+                f"stacked x/y leading axes disagree: {tuple(x.shape)} vs "
+                f"{tuple(y.shape)}")
+        if self.mesh is not None:
+            n = self._dp_size()
+            micro_b = int(x.shape[lead])
+            if micro_b % n != 0:
+                raise MXNetError(
+                    f"multi-step microbatch {micro_b} not divisible by "
+                    f"the mesh's 'dp' axis ({n} shards); the in-program "
+                    "pad path has no stacked analogue — size batches to "
+                    "the mesh (DataLoader last_batch='discard'/'rollover')")
+        return k, x, y
 
     def _validate_batch(self, x):
         """Rows of in-program zero-weight padding needed to even the batch
@@ -727,7 +841,7 @@ class CompiledTrainStep:
                              for k in by_dt[dt]], n))
                 for dt in sorted(by_dt)]
 
-    def _build(self, x, y, pad=0):
+    def _build(self, x, y, pad=0, k=None, g=1):
         """Trace + compile one program for this input signature. Under FSDP
         the per-param buffers were released at adoption; re-traces need them
         back (the deferred-compute variables must bind to the SAME NDArray
@@ -735,10 +849,10 @@ class CompiledTrainStep:
         materialize/release."""
         st = self._fsdp_state
         if st is None:
-            return self._build_program(x, y, pad=pad)
+            return self._build_program(x, y, pad=pad, k=k, g=g)
         st.materialize_into_params()
         try:
-            return self._build_program(x, y, pad=pad)
+            return self._build_program(x, y, pad=pad, k=k, g=g)
         finally:
             st.release_params()
 
@@ -766,7 +880,7 @@ class CompiledTrainStep:
                    for k, (nm, i) in enumerate(zip(names, train_idx))]
         return fsdp_groups(entries, specs, self._dp_size())
 
-    def _build_program(self, x, y, pad=0):
+    def _build_program(self, x, y, pad=0, k=None, g=1):
         import jax
         import jax.numpy as jnp
         import numpy as onp
@@ -774,9 +888,18 @@ class CompiledTrainStep:
         from . import _deferred_compute as dc
         from . import autograd as ag
         from .cached_op import build_executor
+        from .ndarray.ndarray import NDArray
 
         tr = self.trainer
         opt = tr._optimizer
+        multi = k is not None or g > 1
+        if multi:
+            # the forward traces on ONE microbatch; the scan supplies the
+            # leading step (and accumulation) axes at run time
+            if pad:
+                raise MXNetError("multi-step programs take exact batches")
+            idx = (0, 0) if g > 1 else (0,)
+            x, y = NDArray(x._data[idx]), NDArray(y._data[idx])
         weighted = pad > 0
         with ag.train_mode():
             if any(p._data is None
@@ -897,12 +1020,12 @@ class CompiledTrainStep:
                  f"opt={type(opt).__name__} scaler={scaler_on} "
                  f"mesh={mesh is not None} sharded={sharded} pad={pad}")
 
-        def body(ws, ss, fs, xb, yb, wv, key, lrs, wds, ts, rescale,
-                 loss_scale):
-            # executes at TRACE time only: the python loop unrolls into one
-            # program, and the observers below count recompiles, not calls
-            self._traces += 1
-            _telemetry.record_compile(site, (ws, xb), attrs=attrs)
+        def grad_part(ws, fs, xb, yb, wv, key, loss_scale):
+            # forward + loss + backward for ONE microbatch: returns the
+            # (reduced) loss, the all_reduce'd aux updates and the LOCAL
+            # gradients — the update half applies the dp reduction.
+            # Executes at TRACE time only: the python loop unrolls into
+            # one program.
             if mesh is not None and uses_rng:
                 from .parallel import collectives as coll
 
@@ -966,7 +1089,6 @@ class CompiledTrainStep:
                 aux = list(outs[1:])
                 if mesh is not None:
                     loss_v = coll.all_reduce(loss_v, "dp", op="sum")
-                grad_op = "sum"
             else:
                 def lfn(w_tuple):
                     args = ([key] if uses_rng else []) + [xb, yb] + \
@@ -982,29 +1104,11 @@ class CompiledTrainStep:
                     from .parallel import collectives as coll
 
                     loss_v = coll.all_reduce(loss_v, "dp", op="mean")
-                grad_op = "mean"
             if mesh is not None:
                 from .parallel import collectives as coll
 
                 aux = [coll.all_reduce(a, "dp", op="mean") for a in aux]
-
-            if fsdp:
-                upd = _fsdp_update(
-                    ws, ss, grads, lrs, wds, ts, rescale, grad_op)
-                return (loss_v, tuple(aux)) + upd
-            if bucketed:
-                upd = _bucket_update(
-                    ws, ss, grads, lrs, wds, ts, rescale, grad_op)
-                return (loss_v, tuple(aux)) + upd
-            if mesh is not None:
-                from .parallel import collectives as coll
-
-                # non-elementwise recurrence: reduce per tensor, then run
-                # the full-tensor update replicated on every device
-                grads = tuple(coll.all_reduce(g, "dp", op=grad_op)
-                              for g in grads)
-            return (loss_v, tuple(aux)) + _per_tensor_update(
-                ws, ss, grads, lrs, wds, ts, rescale)
+            return loss_v, tuple(aux), grads
 
         def _per_tensor_update(ws, ss, grads, lrs, wds, ts, rescale):
             # single-device + non-elementwise-mesh path: the original
@@ -1149,7 +1253,38 @@ class CompiledTrainStep:
                 new_ss.append(ns)
             return new_ws, tuple(new_ss), overflow
 
-        fn = body
+        # the dp reduction op is build-static: weighted (padded) batches
+        # must SUM their pre-divided local grads, whole batches pmean
+        grad_op = "sum" if weighted else "mean"
+
+        def update_part(ws, ss, grads, lrs, wds, ts, rescale):
+            # dp-reduce the gradients and run the optimizer recurrence —
+            # the second half of the step body, shared by the single-step
+            # and scanned paths
+            if fsdp:
+                return _fsdp_update(ws, ss, grads, lrs, wds, ts, rescale,
+                                    grad_op)
+            if bucketed:
+                return _bucket_update(ws, ss, grads, lrs, wds, ts, rescale,
+                                      grad_op)
+            if mesh is not None:
+                from .parallel import collectives as coll
+
+                # non-elementwise recurrence: reduce per tensor, then run
+                # the full-tensor update replicated on every device
+                grads = tuple(coll.all_reduce(g, "dp", op=grad_op)
+                              for g in grads)
+            return _per_tensor_update(ws, ss, grads, lrs, wds, ts, rescale)
+
+        def body(ws, ss, fs, xb, yb, wv, key, lrs, wds, ts, rescale,
+                 loss_scale):
+            loss_v, aux, grads = grad_part(ws, fs, xb, yb, wv, key,
+                                           loss_scale)
+            new_ws, new_ss, overflow = update_part(ws, ss, grads, lrs, wds,
+                                                   ts, rescale)
+            return loss_v, aux, new_ws, new_ss, overflow
+
+        # shard_map specs shared by the single-step and scanned wrappers
         if mesh is not None:
             from .parallel.mesh import P, shard_map_compat
 
@@ -1168,6 +1303,127 @@ class CompiledTrainStep:
                 ss_spec = dp if bucketed else P()
                 out_ws = P()
                 out_state = dp if bucketed else P()
+
+        if multi:
+            # --- scanned super-step: K optimizer steps (each accumulating
+            # G microbatches) as ONE lax.scan over the step body ----------
+            from .parallel.collectives import match_carry_vma
+
+            # aux (BN moving stats) must flow BETWEEN inner steps: map each
+            # aux target to its frozen-input position so the scan carries
+            # those fs entries (the single-step trace reads fs once)
+            fs_pos = {id(p.data()): j for j, (_, p) in enumerate(frozen)}
+            aux_pos = []
+            for t in aux_targets:
+                j = fs_pos.get(id(t))
+                if j is None:
+                    self.fallback_reason = (
+                        "multi-step scan: an aux-update target is not a "
+                        "frozen parameter input")
+                    return None
+                aux_pos.append(j)
+
+            def sub_fs(fs, aux_vals):
+                fs = list(fs)
+                for j, a in zip(aux_pos, aux_vals):
+                    fs[j] = a
+                return fs
+
+            def one_step(ws, ss, fs, xb, yb, kb, lrs, wds, ts, rescale,
+                         loss_scale):
+                # one optimizer step = G accumulated microbatches. Grad
+                # shapes differ from ws under FSDP (pre-scattered), so the
+                # accumulator is seeded by microbatch 0 and an inner scan
+                # sums the remaining G-1, threading BN aux sequentially
+                if g == 1:
+                    loss_v, aux, grads = grad_part(ws, fs, xb, yb, None,
+                                                   kb, loss_scale)
+                else:
+                    loss_v, aux, grads = grad_part(ws, fs, xb[0], yb[0],
+                                                   None, kb[0], loss_scale)
+
+                    def acc(c, sl):
+                        l_a, g_a, aux_c = c
+                        xj, yj, kj = sl
+                        l_j, aux_j, g_j = grad_part(
+                            ws, sub_fs(fs, aux_c), xj, yj, None, kj,
+                            loss_scale)
+                        return (l_a + l_j,
+                                tuple(a + b for a, b in zip(g_a, g_j)),
+                                aux_j), None
+
+                    carry = (loss_v, tuple(grads), aux)
+                    if mesh is not None:
+                        carry = match_carry_vma(
+                            acc, carry, (xb[1], yb[1], kb[1]),
+                            fallback_axis="dp")
+                    (loss_v, grads, aux), _ = jax.lax.scan(
+                        acc, carry, (xb[1:], yb[1:], kb[1:]))
+                    # mean over the G microbatches: sum-then-divide equals
+                    # the mean over the G*B super-batch
+                    loss_v = loss_v / g
+                    grads = tuple(gr / g for gr in grads)
+                new_ws, new_ss, overflow = update_part(ws, ss, grads, lrs,
+                                                       wds, ts, rescale)
+                return loss_v, aux, new_ws, new_ss, overflow
+
+            def super_fn(ws, ss, fs, xs, ys, keys, lrs_t, wds_t, ts_t,
+                         rescale, loss_scale):
+                # carry structures must match the body's OUTPUT structures
+                # (lists for ws, residency-dependent for ss)
+                ws = list(ws)
+                ss = tuple(ss) if (fsdp or bucketed) else \
+                    [tuple(s) for s in ss]
+                aux0 = tuple(fs[j] for j in aux_pos)
+
+                def step(carry, sl):
+                    ws_c, ss_c, aux_c, c = carry
+                    xj, yj, kj = sl
+                    # per-inner-step hypers indexed by the COMMITTED count
+                    # c, not the loop index: an overflow-skipped step must
+                    # leave the schedule untouched, exactly the eager skip
+                    loss_v, aux, new_ws, new_ss, ovf = one_step(
+                        ws_c, ss_c, sub_fs(fs, aux_c), xj, yj, kj,
+                        lrs_t[c], wds_t[c], ts_t[c], rescale, loss_scale)
+                    if scaler_on:
+                        c = c + 1 - ovf.astype(jnp.int32)
+                    else:
+                        c = c + 1
+                    return (new_ws, new_ss, aux, c), (loss_v, ovf)
+
+                carry = (ws, ss, aux0, jnp.zeros((), jnp.int32))
+                proto = (xs[0], ys[0], keys[0])
+                if mesh is not None:
+                    carry = match_carry_vma(step, carry, proto,
+                                            fallback_axis="dp")
+                (ws, ss, aux, _), (losses, ovfs) = jax.lax.scan(
+                    step, carry, (xs, ys, keys))
+                return losses, aux, ws, ss, ovfs
+
+            if mesh is not None:
+                x_sp = P(None, None, "dp") if g > 1 else P(None, "dp")
+                inner_multi = shard_map_compat(
+                    super_fn, mesh,
+                    in_specs=(ws_spec, ss_spec, P(), x_sp, x_sp,
+                              P(), P(), P(), P(), P(), P()),
+                    out_specs=(P(), P(), out_ws, out_state, P()))
+            else:
+                inner_multi = super_fn
+            m_attrs = attrs + f" k={k} g={g}"
+
+            def multi_fn(ws, ss, fs, xs, ys, keys, lrs_t, wds_t, ts_t,
+                         rescale, loss_scale):
+                # executes at TRACE time only — the observers count
+                # recompiles, not calls (the scan body may be re-traced
+                # abstractly by match_carry_vma; only this top-level
+                # wrapper marks the compile site)
+                self._traces += 1
+                _telemetry.record_compile(site, (ws, xs), attrs=m_attrs)
+                return inner_multi(ws, ss, fs, xs, ys, keys, lrs_t, wds_t,
+                                   ts_t, rescale, loss_scale)
+
+            fn = multi_fn
+        elif mesh is not None:
             inner = shard_map_compat(
                 body, mesh,
                 in_specs=(ws_spec, ss_spec, P(), dp, dp,
@@ -1179,6 +1435,10 @@ class CompiledTrainStep:
 
                 def padded(ws, ss, fs, xb, yb, key, lrs, wds, ts, rescale,
                            loss_scale):
+                    # executes at TRACE time only — the observers count
+                    # recompiles, not calls
+                    self._traces += 1
+                    _telemetry.record_compile(site, (ws, xb), attrs=attrs)
                     # pad IN-PROGRAM: the host hands the ragged batch as-is
                     xb = jnp.pad(xb, ((0, pad),) + ((0, 0),) * (xb.ndim - 1))
                     yb = jnp.pad(yb, ((0, pad),) + ((0, 0),) * (yb.ndim - 1))
@@ -1190,6 +1450,8 @@ class CompiledTrainStep:
             else:
                 def unweighted(ws, ss, fs, xb, yb, key, lrs, wds, ts,
                                rescale, loss_scale):
+                    self._traces += 1
+                    _telemetry.record_compile(site, (ws, xb), attrs=attrs)
                     wv = jnp.zeros((n_dp,), jnp.float32)  # unused
                     return inner(ws, ss, fs, xb, yb, wv, key, lrs, wds, ts,
                                  rescale, loss_scale)
@@ -1198,6 +1460,8 @@ class CompiledTrainStep:
         else:
             def no_mesh(ws, ss, fs, xb, yb, key, lrs, wds, ts, rescale,
                         loss_scale):
+                self._traces += 1
+                _telemetry.record_compile(site, (ws, xb), attrs=attrs)
                 return body(ws, ss, fs, xb, yb, None, key, lrs, wds, ts,
                             rescale, loss_scale)
 
@@ -1205,6 +1469,9 @@ class CompiledTrainStep:
         coll_bytes = self._collective_bytes(train_idx, aux_targets, buckets,
                                             bucketed, weighted, scaler_on,
                                             groups=groups, remat=remat)
+        if multi:
+            # per-dispatch payload scales with the k*g microbatches scanned
+            coll_bytes = tuple(b * (k * g) for b in coll_bytes)
         if fsdp and self._fsdp_state is None:
             # adoption AFTER the trace (it releases the per-param buffers
             # the trace just bound); like the ZeRO-1 state, the residency
@@ -1220,7 +1487,8 @@ class CompiledTrainStep:
                 for layer, dt, _, bs, sh in groups)
         return _Program(jax.jit(fn, donate_argnums=(0, 1)), uses_rng,
                         aux_targets, sharded=bucketed, fsdp=fsdp,
-                        coll_bytes=coll_bytes)
+                        coll_bytes=coll_bytes,
+                        k=k if multi else None, accum=g)
 
     @staticmethod
     def _pad_rows(arr, pad):
@@ -1301,15 +1569,12 @@ class CompiledTrainStep:
             for _, ks, bs in self._buckets)
 
     # -- the compiled step --------------------------------------------------
-    def _run(self, prog, x, y):
-        import jax.numpy as jnp
-        import numpy as onp
-
+    def _assemble_inputs(self, prog):
+        """Gather the donated weight/state operands for one dispatch,
+        per the program's residency mode."""
         tr = self.trainer
-        opt = tr._optimizer
         idxs = self._train_idx
         keys = self._state_keys
-        scaler = self.loss_scaler
         if prog.fsdp:
             # FSDP: weights AND state are the resident bucket shards; no
             # full-sized value is ever assembled on the host
@@ -1328,26 +1593,11 @@ class CompiledTrainStep:
             ws = [tr._params[i].data()._data for i in idxs]
             ss = [tuple(tr._states[i][k]._data for k in keys) for i in idxs]
         fs = [p.data()._data for _, p in self._frozen]
-        if prog.uses_rng:
-            from . import random as _rnd
+        return ws, ss, fs
 
-            key = _rnd._next_key()
-        else:
-            key = jnp.zeros((2,), jnp.uint32)
-        # scalar schedule inputs are RUNTIME operands (the trainer rule):
-        # counts are STAGED, not committed — an overflow-skipped step must
-        # leave the schedule exactly where the eager skip would
-        counts, num_update = opt._staged_counts(idxs)
-        ts = onp.asarray(counts, onp.float32)
-        lrs = onp.asarray([opt._get_lr(i, num_update=num_update)
-                           for i in idxs], onp.float32)
-        wds = onp.asarray([opt._get_wd(i) for i in idxs], onp.float32)
-        scale = float(scaler.loss_scale) if scaler is not None else 1.0
-        rescale = onp.float32(tr._scale / scale)
-        loss_scale = onp.float32(scale)
+    def _dispatch(self, prog, args):
+        """Compile on first use, account the dispatch, run the program."""
         self._dispatches += 1
-        args = (ws, ss, fs, x._data, y._data, key, lrs, wds, ts, rescale,
-                loss_scale)
         if prog.compiled is None:
             # first dispatch of this signature: lower + compile explicitly
             # — the one XLA compile the implicit jit call would pay anyway
@@ -1367,25 +1617,30 @@ class CompiledTrainStep:
             if cost:
                 prog.flops = cost["flops"]
                 prog.bytes_accessed = cost["bytes_accessed"]
-        if _telemetry.ON:
-            # ONE compiled-program call per step; this bypasses the
-            # invoke() chokepoint, so count the dispatch here
-            _telemetry.record_dispatch()
-            _telemetry.record_flops(prog.flops, prog.bytes_accessed)
-            rs_b, ag_b, ps_b = prog.coll_bytes
-            if prog.sharded and not self.shard_update:
-                # replicated residency: the host-side state reshard is
-                # scatter + gather traffic on top of the program's own
-                rs_b += self._state_bucket_bytes
-                ag_b += self._state_bucket_bytes
-            _telemetry.record_collective(rs_b, ag_b, ps_b)
-            if prog.fsdp:
-                _telemetry.record_fsdp(self._fsdp_layer_bytes)
-            with _telemetry.program_timer("train_step"):
-                out = prog.compiled(*args)
-        else:
-            out = prog.compiled(*args)
-        loss_v, aux, new_ws, new_ss, overflow = out
+        if not _telemetry.ON:
+            return prog.compiled(*args)
+        # ONE compiled-program call per (super-)step; this bypasses the
+        # invoke() chokepoint, so count the dispatch here
+        _telemetry.record_dispatch()
+        _telemetry.record_flops(prog.flops, prog.bytes_accessed)
+        rs_b, ag_b, ps_b = prog.coll_bytes
+        if prog.sharded and not self.shard_update:
+            # replicated residency: the host-side state reshard is
+            # scatter + gather traffic on top of the program's own
+            rs_b += self._state_bucket_bytes
+            ag_b += self._state_bucket_bytes
+        _telemetry.record_collective(rs_b, ag_b, ps_b)
+        if prog.fsdp:
+            _telemetry.record_fsdp(self._fsdp_layer_bytes)
+        with _telemetry.program_timer("train_step"):
+            return prog.compiled(*args)
+
+    def _writeback(self, prog, new_ws, new_ss, aux):
+        """Rebind the program's donated outputs into the host-visible
+        parameter/state objects, per residency mode."""
+        tr = self.trainer
+        idxs = self._train_idx
+        keys = self._state_keys
         if prog.fsdp:
             # outputs ARE the updated bucket shards: no per-param weight
             # writeback exists (or is wanted) — rebind the residency
@@ -1413,6 +1668,37 @@ class CompiledTrainStep:
         # during the forward, before the eager loop could inspect grads
         for target, arr in zip(prog.aux_targets, aux):
             target._set_data(arr)
+
+    def _run(self, prog, x, y):
+        import jax.numpy as jnp
+        import numpy as onp
+
+        tr = self.trainer
+        opt = tr._optimizer
+        idxs = self._train_idx
+        scaler = self.loss_scaler
+        ws, ss, fs = self._assemble_inputs(prog)
+        if prog.uses_rng:
+            from . import random as _rnd
+
+            key = _rnd._next_key()
+        else:
+            key = jnp.zeros((2,), jnp.uint32)
+        # scalar schedule inputs are RUNTIME operands (the trainer rule):
+        # counts are STAGED, not committed — an overflow-skipped step must
+        # leave the schedule exactly where the eager skip would
+        counts, num_update = opt._staged_counts(idxs)
+        ts = onp.asarray(counts, onp.float32)
+        lrs = onp.asarray([opt._get_lr(i, num_update=num_update)
+                           for i in idxs], onp.float32)
+        wds = onp.asarray([opt._get_wd(i) for i in idxs], onp.float32)
+        scale = float(scaler.loss_scale) if scaler is not None else 1.0
+        rescale = onp.float32(tr._scale / scale)
+        loss_scale = onp.float32(scale)
+        out = self._dispatch(prog, (ws, ss, fs, x._data, y._data, key, lrs,
+                                    wds, ts, rescale, loss_scale))
+        loss_v, aux, new_ws, new_ss, overflow = out
+        self._writeback(prog, new_ws, new_ss, aux)
         if scaler is not None:
             ovf = bool(overflow)  # the step's only host sync (1 byte)
             scaler.update_scale(ovf)
@@ -1425,6 +1711,68 @@ class CompiledTrainStep:
         from .ndarray.ndarray import NDArray
 
         return NDArray(loss_v)
+
+    def _run_multi(self, prog, x, y):
+        import time as _time
+
+        import jax.numpy as jnp
+        import numpy as onp
+
+        t_host0 = _time.perf_counter()
+        tr = self.trainer
+        opt = tr._optimizer
+        idxs = self._train_idx
+        scaler = self.loss_scaler
+        k, g = prog.k, prog.accum
+        ws, ss, fs = self._assemble_inputs(prog)
+        if prog.uses_rng:
+            from . import random as _rnd
+
+            # one key PER MICROBATCH, drawn in the exact order the
+            # sequential loop would draw them (RNG-trajectory parity)
+            flat = [_rnd._next_key() for _ in range(k * g)]
+            keys = jnp.stack(flat).reshape((k, g, 2) if g > 1 else (k, 2))
+        else:
+            keys = jnp.zeros((k, g, 2) if g > 1 else (k, 2), jnp.uint32)
+        # per-inner-step hyper table: row j = what the j-th COMMITTED step
+        # would stage; the program indexes rows by its in-scan committed
+        # counter, so overflow skips freeze the schedule exactly like the
+        # eager loop (and K sequential compiled steps)
+        rows, nus = opt._staged_counts_k(idxs, k)
+        ts = onp.asarray(rows, onp.float32)
+        lrs = onp.asarray(
+            [[opt._get_lr(i, num_update=nu) for i in idxs] for nu in nus],
+            onp.float32)
+        wd_row = [opt._get_wd(i) for i in idxs]
+        wds = onp.asarray([wd_row] * k, onp.float32)
+        scale = float(scaler.loss_scale) if scaler is not None else 1.0
+        rescale = onp.float32(tr._scale / scale)
+        loss_scale = onp.float32(scale)
+        out = self._dispatch(prog, (ws, ss, fs, x._data, y._data, keys, lrs,
+                                    wds, ts, rescale, loss_scale))
+        losses, aux, new_ws, new_ss, ovfs = out
+        self._writeback(prog, new_ws, new_ss, aux)
+        # the super-step's only host sync: the K overflow flags (K bytes)
+        t_s0 = _time.perf_counter()
+        flags = onp.asarray(ovfs)
+        t_s1 = _time.perf_counter()
+        if scaler is not None:
+            clean = scaler.replay(flags)
+        else:
+            clean = k
+        for _ in range(clean):
+            opt._commit_counts(idxs)
+        if _telemetry.ON:
+            # host cost per trained step, the sync wait excluded (that
+            # time is the device computing, not the host dispatching)
+            host_ms = ((_time.perf_counter() - t_host0) -
+                       (t_s1 - t_s0)) * 1e3 / k
+            _telemetry.gauge("train.host_ms_per_step").set(host_ms)
+            _telemetry.gauge("train.dispatches_per_step").set(1.0 / k)
+            _telemetry.mark_step(inner_steps=k)
+        from .ndarray.ndarray import NDArray
+
+        return NDArray(losses)
 
     # -- the uncompiled fallback -------------------------------------------
     def _eager_step(self, x, y):

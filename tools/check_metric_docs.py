@@ -1,8 +1,8 @@
 #!/usr/bin/env python3
 """Lint: every ``serve.*`` / ``telemetry.*`` / ``checkpoint.*`` /
-``fault.*`` metric name created anywhere in ``mxnet_tpu/`` must appear in
-docs/DESIGN.md (the Observability metric inventory), so the exported
-namespace and the documentation cannot drift.
+``fault.*`` / ``train.*`` metric name created anywhere in ``mxnet_tpu/``
+must appear in docs/DESIGN.md (the Observability metric inventory), so
+the exported namespace and the documentation cannot drift.
 
 Literal names must appear verbatim; f-string names (dynamic buckets like
 ``serve.bucket{bucket}.call``) are checked by their literal prefix up to
@@ -23,7 +23,7 @@ DESIGN = ROOT / "docs" / "DESIGN.md"
 # Histogram("serve.ttft_ms", ...)
 _CREATE = re.compile(
     r"(?:counter|gauge|timer|histogram|Counter|Gauge|Timer|Histogram)\(\s*"
-    r"(f?)([\"'])((?:serve|telemetry|checkpoint|fault)\.[^\"']*)\2")
+    r"(f?)([\"'])((?:serve|telemetry|checkpoint|fault|train)\.[^\"']*)\2")
 
 
 def collect(src_root=None):
@@ -55,8 +55,8 @@ def main():
     missing = missing_names()
     if not missing:
         print(f"metric docs lint: all {len(collect())} "
-              "serve./telemetry./checkpoint./fault. names documented in "
-              "docs/DESIGN.md")
+              "serve./telemetry./checkpoint./fault./train. names "
+              "documented in docs/DESIGN.md")
         return 0
     print("metric names missing from docs/DESIGN.md:", file=sys.stderr)
     for name, sites in sorted(missing.items()):
