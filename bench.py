@@ -77,6 +77,35 @@ def _sync(data):
     return onp.asarray(data.ravel()[0] if hasattr(data, "ravel") else data)
 
 
+def _mem_section(top_k=0):
+    """Compact memory-ledger slice for a bench JSON (per-program static
+    peaks, live-bytes high water, headroom vs the configured limit)."""
+    from mxnet_tpu import telemetry
+
+    rep = telemetry.memory_report(top_k)
+    return {"program_peak_bytes":
+                {site: ent["peak_bytes"]
+                 for site, ent in sorted(rep["programs"].items())},
+            "live_bytes": rep["live"]["live_bytes"],
+            "live_bytes_high_water": rep["live_bytes_high_water"],
+            "limit_bytes": rep["limit_bytes"],
+            "headroom_fraction": rep["headroom_fraction"]}
+
+
+def _with_numerics(nmode, fn):
+    """Run ``fn`` with MXTPU_NUMERICS pinned (the mode is read at program
+    BUILD time, so an on/off comparison needs a fresh compile per leg)."""
+    old = os.environ.get("MXTPU_NUMERICS")
+    os.environ["MXTPU_NUMERICS"] = nmode
+    try:
+        return fn()
+    finally:
+        if old is None:
+            os.environ.pop("MXTPU_NUMERICS", None)
+        else:
+            os.environ["MXTPU_NUMERICS"] = old
+
+
 def bench_resnet_infer():
     import mxnet_tpu as mx
     from mxnet_tpu.cached_op import trace
@@ -156,9 +185,11 @@ def bench_train_step():
     """Whole-step compilation (Trainer.compile_step: ONE donated-buffer
     program per step) against the eager record/backward/``Trainer.step``
     loop, on an MLP+BN classifier. Reports compiled steps/s, the
-    compiled/eager ratio, dispatches/step, and compile counts (from
-    telemetry, measured outside the timed loops). BENCH_TRAIN_STEP_SMALL=1
-    shrinks the model/iterations for the not-slow suite."""
+    compiled/eager ratio, dispatches/step, compile counts (from telemetry,
+    measured outside the timed loops), the numerics-monitor overhead
+    (steps/s with MXTPU_NUMERICS=cheap vs off) and the static memory
+    ledger. BENCH_TRAIN_STEP_SMALL=1 shrinks the model/iterations for the
+    not-slow suite."""
     import mxnet_tpu as mx
     from mxnet_tpu import autograd as ag, gluon, telemetry
     from mxnet_tpu.gluon import nn
@@ -198,18 +229,25 @@ def bench_train_step():
     _sync(loss._data)
     eager_sps = ITERS / (time.perf_counter() - t0)
 
-    net_c = make_net()
-    tr_c = gluon.Trainer(net_c.collect_params(), *opt_args)
-    step = tr_c.compile_step(net_c, loss_fn)
-    if step.fallback_reason is not None:
-        raise RuntimeError("compile_step fell back: " + step.fallback_reason)
-    for _ in range(WARMUP):
-        _sync(step(x, y)._data)
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        loss = step(x, y)
-    _sync(loss._data)
-    compiled_sps = ITERS / (time.perf_counter() - t0)
+    def timed_compiled():
+        net_c = make_net()
+        tr_c = gluon.Trainer(net_c.collect_params(), *opt_args)
+        st = tr_c.compile_step(net_c, loss_fn)
+        if st.fallback_reason is not None:
+            raise RuntimeError("compile_step fell back: "
+                               + st.fallback_reason)
+        for _ in range(WARMUP):
+            _sync(st(x, y)._data)
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = st(x, y)
+        _sync(loss._data)
+        return st, ITERS / (time.perf_counter() - t0)
+
+    # numerics monitor overhead: same net/loop compiled with the in-program
+    # health outputs (cheap, the default) vs without (off)
+    step, compiled_sps = _with_numerics("cheap", timed_compiled)
+    _, off_sps = _with_numerics("off", timed_compiled)
 
     # accounting pass AFTER the timed loops: telemetry on, a few steps,
     # read dispatches/recompiles per step from the accountant
@@ -241,6 +279,11 @@ def bench_train_step():
                 (round(prog["achieved_flops_s"], 1)
                  if prog.get("achieved_flops_s") else None),
             "peak_flops_source": _peak_source(),
+            "numerics_off_steps_per_sec": round(off_sps, 2),
+            "numerics_overhead_pct":
+                round(100.0 * (off_sps - compiled_sps) /
+                      max(off_sps, 1e-9), 2),
+            "memory": _mem_section(),
             "mfu": round(mfus[-1], 4) if mfus else None}
 
 
@@ -501,7 +544,12 @@ def bench_train_step_multi():
     was_on = telemetry.is_enabled()
     telemetry.enable()
     try:
-        sweep = {str(k): run_k(k) for k in ks}
+        # the sweep runs with the in-program numerics monitor on (cheap,
+        # the default); one extra off leg at the headline K measures its
+        # steps/s overhead — same dispatches/step both ways by design
+        sweep = {str(k): _with_numerics("cheap", lambda k=k: run_k(k))
+                 for k in ks}
+        off = _with_numerics("off", lambda: run_k(want_k))
     finally:
         telemetry.enable() if was_on else telemetry.disable()
     head = sweep[str(want_k)]
@@ -517,7 +565,12 @@ def bench_train_step_multi():
             "dispatches_per_step": head["dispatches_per_step"],
             "recompiles_after_warmup": head["recompiles_after_warmup"],
             "dp_size": int(n_dp),
+            "numerics_off_steps_per_sec": off["steps_per_sec"],
+            "numerics_overhead_pct":
+                round(100.0 * (off["steps_per_sec"] - head["steps_per_sec"])
+                      / max(off["steps_per_sec"], 1e-9), 2),
             "sweep": sweep,
+            "memory": _mem_section(),
             "mfu": None}
 
 
@@ -1025,6 +1078,7 @@ def bench_serve_llm():
                or {}).get("serve.decode") or {}
         tps_chip = telemetry.gauge("serve.tokens_per_s_chip").value
         st = eng.stats()
+        mem = _mem_section()  # while the engine (KV cache, slots) is live
         eng.close()
     finally:
         telemetry.enable() if was_on else telemetry.disable()
@@ -1049,6 +1103,7 @@ def bench_serve_llm():
             "compiles_steady": compiles_steady,
             "achieved_flops_per_sec": round(achieved, 1),
             "peak_flops_source": _peak_source(),
+            "memory": mem,
             "mfu": _mfu(achieved)}
 
 

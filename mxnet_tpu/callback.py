@@ -64,6 +64,12 @@ class Speedometer:
         mfus = [r["mfu"] for r in rows if r.get("mfu") is not None]
         if mfus:
             text += f"\tmfu={mfus[-1]:.3f}"
+        gnorms = [r["grad_norm"] for r in rows if r.get("grad_norm")]
+        if gnorms:
+            text += f"\tgrad_norm={gnorms[-1]:.4g}"
+        nonfin = sum(r.get("nonfinite_steps", 0) for r in rows)
+        if nonfin:
+            text += f"\tnonfinite={nonfin}"
         tps = _tm.REGISTRY.gauge("serve.tokens_per_s_chip").value
         if tps:
             text += f"\ttok/s/chip={tps:.0f}"

@@ -199,13 +199,13 @@ def build_five_axis_train_step(mesh, n_heads, lr=0.1, num_microbatches=None,
                 f"local microbatch tokens {tokens} not divisible by ep size "
                 f"{ep}; the MoE dispatch would silently truncate tokens")
 
-    from jax import shard_map
+    from .mesh import shard_map_compat
 
-    loss_sm = shard_map(
+    loss_sm = shard_map_compat(
         functools.partial(_loss_body, n_heads=n_heads,
                           num_microbatches=num_microbatches,
                           moe_capacity=moe_capacity),
-        mesh=mesh,
+        mesh,
         in_specs=(param_specs, x_spec, y_spec),
         out_specs=P(),
     )

@@ -76,7 +76,7 @@ def moe_sharded(x, gate_w, expert_w1, expert_w2, mesh, axis="ep",
                 capacity=None):
     """User-facing MoE layer over a mesh: tokens sharded over ``ep``,
     experts sharded over ``ep``, gate replicated."""
-    from jax import shard_map
+    from .mesh import shard_map_compat
 
     from ..ndarray.ndarray import NDArray
 
@@ -84,9 +84,9 @@ def moe_sharded(x, gate_w, expert_w1, expert_w2, mesh, axis="ep",
         raise MXNetError(f"mesh has no axis {axis!r}")
     unwrap = lambda a: a._data if isinstance(a, NDArray) else a  # noqa: E731
     xd, gw, w1, w2 = map(unwrap, (x, gate_w, expert_w1, expert_w2))
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(moe_apply, axis_name=axis, capacity=capacity),
-        mesh=mesh,
+        mesh,
         in_specs=(P(axis), P(), P(axis), P(axis)),
         out_specs=P(axis),
     )

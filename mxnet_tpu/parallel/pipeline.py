@@ -88,7 +88,7 @@ def pipeline_sharded(stage_fn, stacked_params, x, mesh, num_microbatches,
     x: (batch, ...) input; split into ``num_microbatches`` along axis 0.
     Returns the pipeline output with the original batch layout.
     """
-    from jax import shard_map
+    from .mesh import shard_map_compat
 
     from ..ndarray.ndarray import NDArray
 
@@ -113,9 +113,9 @@ def pipeline_sharded(stage_fn, stacked_params, x, mesh, num_microbatches,
 
     pspec = jax.tree_util.tree_map(
         lambda p: P(axis, *([None] * (p.ndim - 1))), pd)
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(pipeline_apply, stage_fn, axis_name=axis),
-        mesh=mesh,
+        mesh,
         in_specs=(pspec, P()),
         out_specs=P(),
     )

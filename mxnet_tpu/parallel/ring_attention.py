@@ -104,7 +104,7 @@ def ring_attention_sharded(q, k, v, mesh, axis=None, scale=None,
     Wraps :func:`ring_attention` in shard_map over ``mesh``; accepts framework
     NDArrays or jax arrays and returns the same kind.
     """
-    from jax import shard_map
+    from .mesh import shard_map_compat
 
     from ..ndarray.ndarray import NDArray
     from .mesh import AxisNames
@@ -118,9 +118,9 @@ def ring_attention_sharded(q, k, v, mesh, axis=None, scale=None,
     kd = k._data if isinstance(k, NDArray) else k
     vd = v._data if isinstance(v, NDArray) else v
     spec = P(None, None, axis, None)
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(ring_attention, axis_name=axis, scale=scale,
                           causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        mesh, in_specs=(spec, spec, spec), out_specs=spec)
     out = jax.jit(fn)(qd, kd, vd)
     return NDArray(out) if wrap else out

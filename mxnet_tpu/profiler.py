@@ -89,6 +89,115 @@ def _is_op_event(name: str) -> bool:
     return "::" not in name
 
 
+def _pb_varint(buf, i):
+    r, s = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def _pb_fields(buf):
+    """Yield (field_number, value) over one protobuf message: varints as
+    int, length-delimited fields as bytes. Fixed32/64 are skipped; group
+    wire types abort the walk (xplane never uses either)."""
+    i, n = 0, len(buf)
+    try:
+        while i < n:
+            tag, i = _pb_varint(buf, i)
+            wt = tag & 7
+            if wt == 0:
+                v, i = _pb_varint(buf, i)
+            elif wt == 2:
+                ln, i = _pb_varint(buf, i)
+                v, i = buf[i:i + ln], i + ln
+            elif wt == 1:
+                i += 8
+                continue
+            elif wt == 5:
+                i += 4
+                continue
+            else:
+                return
+            yield tag >> 3, v
+    except IndexError:
+        return
+
+
+def _xplane_planes(data):
+    """Minimal wire-format decode of a serialized XSpace — the fallback when
+    this jax build has no ``jax.profiler.ProfileData`` binding (absent on
+    0.4.x). Yields (plane_name, [(line_name, [(event_name, dur_ns), ...])]).
+
+    Field numbers (tensorflow/profiler xplane.proto): XSpace.planes=1;
+    XPlane{name=2, lines=3, event_metadata=4}; XLine{name=2, events=4,
+    display_name=11}; XEvent{metadata_id=1, duration_ps=3};
+    XEventMetadata{id=1, name=2}; map entries {key=1, value=2}.
+    """
+    for fnum, v in _pb_fields(data):
+        if fnum != 1 or not isinstance(v, bytes):
+            continue
+        plane_name, meta, raw_lines = "", {}, []
+        for pf, pv in _pb_fields(v):
+            if pf == 2 and isinstance(pv, bytes):
+                plane_name = pv.decode("utf-8", "replace")
+            elif pf == 3 and isinstance(pv, bytes):
+                raw_lines.append(pv)
+            elif pf == 4 and isinstance(pv, bytes):
+                mid, mname = 0, ""
+                for kf, kv in _pb_fields(pv):
+                    if kf == 1 and isinstance(kv, int):
+                        mid = kv
+                    elif kf == 2 and isinstance(kv, bytes):
+                        for mf, mv in _pb_fields(kv):
+                            if mf == 1 and isinstance(mv, int):
+                                mid = mv
+                            elif mf == 2 and isinstance(mv, bytes):
+                                mname = mv.decode("utf-8", "replace")
+                if mname:
+                    meta[mid] = mname
+        lines = []
+        for lv in raw_lines:
+            lname, events = "", []
+            for lf, lvv in _pb_fields(lv):
+                if lf == 2 and isinstance(lvv, bytes) and not lname:
+                    lname = lvv.decode("utf-8", "replace")
+                elif lf == 11 and isinstance(lvv, bytes):
+                    lname = lvv.decode("utf-8", "replace")
+                elif lf == 4 and isinstance(lvv, bytes):
+                    mid, dur_ps = 0, 0
+                    for ef, evv in _pb_fields(lvv):
+                        if ef == 1 and isinstance(evv, int):
+                            mid = evv
+                        elif ef == 3 and isinstance(evv, int):
+                            dur_ps = evv
+                    events.append((meta.get(mid, ""), dur_ps / 1e3))
+            lines.append((lname, events))
+        yield plane_name, lines
+
+
+def _trace_events(path):
+    """(plane_name, line_name, [(event_name, dur_ns)]) triples from an
+    xplane.pb, via ProfileData when available, else the wire parser."""
+    try:
+        from jax.profiler import ProfileData
+    except ImportError:
+        with open(path, "rb") as f:
+            data = f.read()
+        for plane_name, lines in _xplane_planes(data):
+            for line_name, events in lines:
+                yield plane_name, line_name, events
+        return
+    pd = ProfileData.from_file(path)
+    for plane in pd.planes:
+        for line in plane.lines:
+            yield plane.name, line.name, [(ev.name, ev.duration_ns)
+                                          for ev in line.events]
+
+
 def get_device_op_stats(trace_dir=None):
     """Parse the captured xplane trace into {op_name: (calls, total_ns)}.
 
@@ -106,27 +215,22 @@ def get_device_op_stats(trace_dir=None):
                              recursive=True))
     if not files:
         return {}
-    try:
-        from jax.profiler import ProfileData
-    except ImportError:
-        return {}
     stats: dict[str, list] = {}
-    pd = ProfileData.from_file(files[-1])
-    for plane in pd.planes:
-        device = "device:" in plane.name.lower() or "tpu" in plane.name.lower()
-        for line in plane.lines:
-            # CPU runs surface XLA ops on the PjRt client lines; TPU runs
-            # on the device plane's op lines
-            client = line.name.startswith("tf_XLA") or \
-                "XLA Ops" in line.name or "XLA Modules" in line.name
-            if not (device or client):
+    for plane_name, line_name, events in _trace_events(files[-1]):
+        device = "device:" in plane_name.lower() or \
+            "tpu" in plane_name.lower()
+        # CPU runs surface XLA ops on the PjRt client lines; TPU runs
+        # on the device plane's op lines
+        client = line_name.startswith("tf_XLA") or \
+            "XLA Ops" in line_name or "XLA Modules" in line_name
+        if not (device or client):
+            continue
+        for name, ns in events:
+            if not _is_op_event(name):
                 continue
-            for ev in line.events:
-                if not _is_op_event(ev.name):
-                    continue
-                s = stats.setdefault(ev.name, [0, 0.0])
-                s[0] += 1
-                s[1] += ev.duration_ns
+            s = stats.setdefault(name, [0, 0.0])
+            s[0] += 1
+            s[1] += ns
     return {k: (c, ns) for k, (c, ns) in stats.items() if ns > 0}
 
 
@@ -278,11 +382,25 @@ def dump_memory_csv(path):
 
 
 def _top_live_buffers(k=10):
+    return live_buffer_census(k)["top"]
+
+
+def live_buffer_census(k=10):
+    """One heap walk over ``jax.live_arrays()``: total live bytes, buffer
+    count, and the top-k buffers as (nbytes, shape, dtype, scope) with
+    birth-scope attribution when profiling recorded one. This is the live
+    half of ``telemetry.memory_report()``'s ledger (the static half comes
+    from per-program ``memory_analysis()``)."""
     import jax
 
-    arrs = sorted(jax.live_arrays(), key=lambda a: -a.nbytes)[:k]
-    return [(a.nbytes, tuple(a.shape), str(a.dtype),
-             _scope_by_id.get(id(a), "<untracked>")) for a in arrs]
+    arrs = jax.live_arrays()
+    top = sorted(arrs, key=lambda a: -a.nbytes)[:k]
+    return {
+        "live_bytes": sum(a.nbytes for a in arrs),
+        "count": len(arrs),
+        "top": [(a.nbytes, tuple(a.shape), str(a.dtype),
+                 _scope_by_id.get(id(a), "<untracked>")) for a in top],
+    }
 
 
 class Profiler:

@@ -422,6 +422,9 @@ class DecodeEngine:
         capped at ``MXTPU_SERVE_RETRY_MAX_MS``; ``point`` is also a chaos
         injection site. Exhaustion re-raises into the crash path."""
         attempt = 0
+        site = "serve.decode_tick" if key[0] == "decode" else \
+            f"serve.prefill_b{key[1]}_t{key[2]}"
+        self._tm.check_memory_admission(site)
         while True:
             try:
                 chaos.fault_point(point)
@@ -429,6 +432,10 @@ class DecodeEngine:
             except (KeyboardInterrupt, SystemExit):
                 raise
             except BaseException as e:  # noqa: BLE001 — bounded retries
+                # a device OOM is not transient: dump the ledger once and
+                # skip the retry storm — the crash path reports upward
+                if self._tm.memory_oom_forensics(site, e):
+                    raise
                 if attempt >= self._retries:
                     raise
                 attempt += 1
@@ -671,6 +678,10 @@ class DecodeEngine:
         if self._tm.ON:
             self._tm.REGISTRY.gauge("serve.slots_live").set(
                 len(self._slot_req))
+            # KV-cache residency for the memory ledger (bytes are static
+            # per engine build; the gauge keys the ledger's kv line)
+            self._tm.REGISTRY.gauge("mem.kv_cache_bytes").set(
+                self._cache.nbytes)
 
     def _drain(self, pending, err=None, status="closed"):
         if err is None:

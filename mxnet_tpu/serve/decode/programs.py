@@ -217,6 +217,7 @@ class DecodePrograms:
         site = ("serve.decode_tick" if kind == "decode"
                 else f"serve.prefill_b{batch}_t{length}")
         cost = _tm.record_program_cost(site, prog)
+        _tm.record_program_memory(site, prog)
         self._costs[key] = ((cost["flops"], cost["bytes_accessed"])
                             if cost else (0.0, 0.0))
         self._signatures["|".join(str(k) for k in key)] = format_signature(

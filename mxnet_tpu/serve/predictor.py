@@ -251,6 +251,7 @@ class Predictor:
             # telemetry/costs.py) — credited at every dispatch below
             cost = self._tm.record_program_cost(f"serve.bucket{bucket}",
                                                 prog)
+            self._tm.record_program_memory(f"serve.bucket{bucket}", prog)
             self._program_costs[bucket] = (
                 (cost["flops"], cost["bytes_accessed"]) if cost
                 else (0.0, 0.0))
@@ -306,7 +307,13 @@ class Predictor:
             from .. import random as _rnd
 
             args.insert(0, _rnd._next_key())
-        outs = self._programs[bucket](*args)
+        site = f"serve.bucket{bucket}"
+        self._tm.check_memory_admission(site)
+        try:
+            outs = self._programs[bucket](*args)
+        except Exception as e:
+            self._tm.memory_oom_forensics(site, e)
+            raise
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
         tm = self._tm

@@ -36,6 +36,8 @@ from .watchdog import Watchdog, format_signature
 from .monitor import Monitor
 from .stall import StallMonitor
 from . import costs as _costs
+from . import memory as _memory
+from . import numerics as _numerics
 
 __all__ = ["enable", "disable", "is_enabled", "configure", "reset",
            "counter", "gauge", "timer", "histogram", "metrics", "event",
@@ -44,6 +46,10 @@ __all__ = ["enable", "disable", "is_enabled", "configure", "reset",
            "record_fsdp", "record_flops", "record_program_cost",
            "new_trace", "finish_trace", "traces", "latency_report",
            "cost_report", "program_costs", "device_peak_flops",
+           "record_program_memory", "program_memory", "memory_report",
+           "check_memory_admission", "memory_oom_forensics",
+           "memory_ledger_text", "numerics_mode", "record_step_health",
+           "numerics_report",
            "start_exporter", "stop_exporter", "exporter_url",
            "stall_heartbeat", "start_stall_watchdog", "stop_stall_watchdog",
            "stall_stats",
@@ -128,6 +134,9 @@ def reset():
     STEPS.reset()
     WATCHDOG.reset()
     TRACES.clear()
+    # numerics host state mirrors the zeroed counters; the memory table
+    # (like costs) mirrors compiled programs and survives
+    _numerics.reset_numerics()
 
 
 # -- metric access ----------------------------------------------------------
@@ -346,6 +355,55 @@ def cost_report():
     """Per-program flops/bytes joined with the ``<site>.call`` timers into
     achieved FLOP/s and MFU (None without a known device peak)."""
     return _costs.cost_report(REGISTRY)
+
+
+# -- device-memory ledger (telemetry/memory.py) -----------------------------
+def record_program_memory(site, compiled):
+    """Capture ``compiled.memory_analysis()`` under ``site`` (unconditional:
+    compile-time only — the memory twin of :func:`record_program_cost`)."""
+    return _memory.record_program_memory(site, compiled)
+
+
+def program_memory():
+    return _memory.program_memory()
+
+
+def memory_report(top_k=10):
+    """The device-memory ledger: static per-program peaks, live-buffer
+    census, device stats, KV/FSDP residency, headroom."""
+    return _memory.memory_report(top_k)
+
+
+def check_memory_admission(site):
+    """Warn-once pre-dispatch admission check (memory.fits)."""
+    return _memory.check_admission(site)
+
+
+def memory_oom_forensics(site, exc):
+    """Dump the ledger if ``exc`` is a device OOM; returns True when it
+    fired. Callers re-raise either way."""
+    return _memory.oom_forensics(site, exc)
+
+
+def memory_ledger_text(top_k=10):
+    return _memory.ledger_text(top_k)
+
+
+# -- numerics health (telemetry/numerics.py) --------------------------------
+def numerics_mode():
+    """``MXTPU_NUMERICS`` → off|cheap|full (default cheap)."""
+    return _numerics.mode()
+
+
+def record_step_health(groups, gnorms, max_upds, nonfin, group_norms=None,
+                       nmode="cheap"):
+    return _numerics.record_step_health(groups, gnorms, max_upds, nonfin,
+                                        group_norms, nmode)
+
+
+def numerics_report():
+    """Host-side summary of the in-program numerics monitor."""
+    return _numerics.numerics_report()
 
 
 def device_peak_flops():

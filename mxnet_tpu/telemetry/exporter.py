@@ -143,11 +143,14 @@ class MetricsExporter:
 
     # -- payloads ------------------------------------------------------------
     def json_snapshot(self):
-        from . import metrics, program_costs, stall_stats
+        from . import (memory_report, metrics, numerics_report,
+                       program_costs, stall_stats)
 
         return {"ts": time.time(), "metrics": metrics(),
                 "program_costs": program_costs(),
-                "stall": stall_stats()}
+                "stall": stall_stats(),
+                "memory": memory_report(),
+                "numerics": numerics_report()}
 
     def health(self):
         import mxnet_tpu.telemetry as tm

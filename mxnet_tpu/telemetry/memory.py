@@ -1,0 +1,299 @@
+"""Device-memory ledger (reference: src/profiler/storage_profiler.h UX over
+XLA's compile-time memory analysis).
+
+Static side: every AOT compile site (train_step programs incl. the multi-step
+scan, serve buckets, decode prefill/decode_tick) records
+``compiled.memory_analysis()`` here at compile time — off the hot path,
+mirroring how :mod:`.costs` captures ``cost_analysis()``. Live side:
+``memory_report()`` joins those static peaks with ``device.memory_stats()``,
+a live-buffer census (:func:`profiler.live_buffer_census`), KV-cache/slot
+bytes and FSDP bucket residency gauges, plus a headroom fraction against
+``MXTPU_MEM_LIMIT_BYTES`` (or the backend's reported limit).
+
+Two enforcement hooks ride the dispatch sites:
+
+- :func:`check_admission` — warn-once pre-dispatch when a program's static
+  peak exceeds the estimated free memory (the primary admission signal of
+  continuous-batching serving stacks).
+- :func:`oom_forensics` — when a dispatch raises RESOURCE_EXHAUSTED, dump
+  the ledger (top live buffers, per-program peaks, live slots) to stderr and
+  the event log before the exception propagates.
+
+Capture never raises: a backend without memory analysis degrades to an
+empty table, exactly like costs.py.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+
+__all__ = ["record_program_memory", "program_memory", "reset_memory",
+           "memory_report", "check_admission", "oom_forensics",
+           "ledger_text", "mem_limit_bytes"]
+
+_LOCK = threading.Lock()
+_MEM: dict[str, dict] = {}       # site -> static memory_analysis capture
+_ADMITTED: set[str] = set()      # sites already admission-checked (warn-once)
+_LIVE_HIGH_WATER = [0]           # live-bytes high-water mark across reports
+
+_log = logging.getLogger("mxnet_tpu.telemetry")
+
+_FIELDS = (("argument_size_in_bytes", "argument_bytes"),
+           ("output_size_in_bytes", "output_bytes"),
+           ("temp_size_in_bytes", "temp_bytes"),
+           ("alias_size_in_bytes", "alias_bytes"),
+           ("generated_code_size_in_bytes", "generated_code_bytes"))
+
+
+def _mem_dict(compiled) -> dict | None:
+    """Normalize ``compiled.memory_analysis()`` into plain ints. Never
+    raises — backends without the analysis yield None."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    out = {}
+    for attr, key in _FIELDS:
+        try:
+            v = int(getattr(ma, attr))
+        except Exception:
+            v = 0
+        out[key] = max(0, v)
+    # donated inputs alias their outputs: the aliased bytes are not paid
+    # twice, so the peak estimate nets them out of the footprint
+    out["peak_bytes"] = max(
+        0, out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        - out["alias_bytes"])
+    return out
+
+
+def record_program_memory(site: str, compiled) -> dict | None:
+    """Capture ``memory_analysis()`` for ``site``. Keep-latest on
+    re-capture (a re-compile at a new shape supersedes the old footprint);
+    re-arms the admission check for the site. Off the hot path."""
+    m = _mem_dict(compiled)
+    if m is None:
+        return None
+    with _LOCK:
+        ent = _MEM.get(site)
+        if ent is None:
+            ent = dict(m)
+            ent["compiles"] = 0
+            _MEM[site] = ent
+        else:
+            ent.update(m)
+        ent["compiles"] += 1
+        ent["captured_at"] = time.time()
+        _ADMITTED.discard(site)
+    try:
+        from . import REGISTRY
+
+        REGISTRY.gauge("mem.program_peak_bytes." + site).set(
+            m["peak_bytes"])
+    except Exception:
+        pass
+    return m
+
+
+def program_memory() -> dict[str, dict]:
+    """Snapshot of the static per-program table (copies)."""
+    with _LOCK:
+        return {site: dict(ent) for site, ent in _MEM.items()}
+
+
+def reset_memory():
+    with _LOCK:
+        _MEM.clear()
+        _ADMITTED.clear()
+    _LIVE_HIGH_WATER[0] = 0
+
+
+def _device_stats() -> dict:
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        return dict(stats) if stats else {}
+    except Exception:
+        return {}
+
+
+def mem_limit_bytes() -> int:
+    """Per-device memory budget: ``MXTPU_MEM_LIMIT_BYTES`` wins (the only
+    source on CPU, where the backend reports no stats), else the backend's
+    ``bytes_limit``. 0 = unknown."""
+    env = os.environ.get("MXTPU_MEM_LIMIT_BYTES", "")
+    if env:
+        try:
+            return max(0, int(float(env)))
+        except ValueError:
+            pass
+    stats = _device_stats()
+    for key in ("bytes_limit", "bytes_reservable_limit"):
+        if stats.get(key):
+            return int(stats[key])
+    return 0
+
+
+def memory_report(top_k: int = 10) -> dict:
+    """The full ledger: static per-program peaks, device stats, live-buffer
+    census, KV-cache/slot and FSDP residency gauges, and headroom against
+    the memory limit. Refreshes the ``mem.*`` gauges as a side effect."""
+    from .. import profiler
+    from . import REGISTRY
+
+    census = profiler.live_buffer_census(top_k)
+    live = census["live_bytes"]
+    if live > _LIVE_HIGH_WATER[0]:
+        _LIVE_HIGH_WATER[0] = live
+    stats = _device_stats()
+    limit = mem_limit_bytes()
+    used = stats.get("bytes_in_use") or live
+    headroom = (limit - used) / limit if limit > 0 else None
+    residency = {}
+    for m in REGISTRY:
+        if m.name.startswith("train_step.") and m.name.endswith(
+                ("_per_replica", "_replicated")):
+            residency[m.name.split(".", 1)[1]] = m.value
+    report = {
+        "programs": program_memory(),
+        "device": stats,
+        "live": census,
+        "live_bytes_high_water": _LIVE_HIGH_WATER[0],
+        "kv_cache_bytes": REGISTRY.gauge("mem.kv_cache_bytes").value,
+        "slots_live": REGISTRY.gauge("serve.slots_live").value,
+        "fsdp_residency": residency,
+        "limit_bytes": limit,
+        "headroom_fraction": headroom,
+    }
+    REGISTRY.gauge("mem.live_bytes").set(live)
+    if headroom is not None:
+        REGISTRY.gauge("mem.headroom_fraction").set(headroom)
+    return report
+
+
+def check_admission(site: str):
+    """Pre-dispatch admission check: warn once per compiled program whose
+    static peak exceeds the estimated free memory. One set lookup on the
+    hot path once a site is admitted; re-armed on re-compile."""
+    if site in _ADMITTED:
+        return
+    with _LOCK:
+        if site in _ADMITTED:
+            return
+        _ADMITTED.add(site)
+        ent = _MEM.get(site)
+    if ent is None:
+        return
+    limit = mem_limit_bytes()
+    if limit <= 0:
+        return
+    stats = _device_stats()
+    used = stats.get("bytes_in_use")
+    if used is None:
+        from .. import profiler
+
+        used = profiler.live_buffer_census(0)["live_bytes"]
+    free = limit - used
+    peak = ent["peak_bytes"]
+    if peak > free:
+        try:
+            from . import EVENTS
+
+            EVENTS.emit("mem.admission", site=site, peak_bytes=peak,
+                        free_bytes=free, limit_bytes=limit)
+        except Exception:
+            pass
+        _log.warning(
+            "memory admission: program %s static peak %s exceeds "
+            "estimated free memory %s (limit %s, in use %s) — dispatch "
+            "may OOM", site, _fmt(peak), _fmt(free), _fmt(limit),
+            _fmt(used))
+
+
+def _fmt(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:,.1f}{unit}" if unit != "B" else f"{int(n):,}B"
+        n /= 1024
+
+
+def ledger_text(top_k: int = 10) -> str:
+    """Human-readable ledger dump — used by OOM forensics and the stall
+    watchdog."""
+    rep = memory_report(top_k)
+    lines = ["-- memory ledger --"]
+    limit = rep["limit_bytes"]
+    head = rep["headroom_fraction"]
+    lines.append(
+        f"live: {_fmt(rep['live']['live_bytes'])} in "
+        f"{rep['live']['count']} buffers (high water "
+        f"{_fmt(rep['live_bytes_high_water'])}), limit "
+        f"{_fmt(limit) if limit else 'unknown'}"
+        + (f", headroom {head:.1%}" if head is not None else ""))
+    if rep["device"]:
+        d = rep["device"]
+        lines.append(f"device: in_use={_fmt(d.get('bytes_in_use', 0))} "
+                     f"peak={_fmt(d.get('peak_bytes_in_use', 0))}")
+    if rep["kv_cache_bytes"]:
+        lines.append(f"kv_cache: {_fmt(rep['kv_cache_bytes'])} "
+                     f"({int(rep['slots_live'])} slots live)")
+    for name, v in sorted(rep["fsdp_residency"].items()):
+        if v:
+            lines.append(f"residency {name}: {_fmt(v)}")
+    progs = sorted(rep["programs"].items(),
+                   key=lambda kv: -kv[1]["peak_bytes"])
+    if progs:
+        lines.append(f"{'program':<32}{'peak':>12}{'temp':>12}{'args':>12}")
+        for site, ent in progs:
+            lines.append(f"{site[:32]:<32}{_fmt(ent['peak_bytes']):>12}"
+                         f"{_fmt(ent['temp_bytes']):>12}"
+                         f"{_fmt(ent['argument_bytes']):>12}")
+    top = rep["live"]["top"]
+    if top:
+        lines.append(f"{'top live buffer':<32}{'shape':<20}{'bytes':>12}")
+        for nbytes, shp, dt, scope in top:
+            lines.append(f"{scope[:32]:<32}"
+                         f"{('x'.join(map(str, shp)) or 'scalar')[:19]:<20}"
+                         f"{_fmt(nbytes):>12}")
+    return "\n".join(lines)
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED", "out of memory",
+                "Out of memory", "OOM")
+
+
+def is_oom(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}"
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def oom_forensics(site: str, exc: BaseException) -> bool:
+    """If ``exc`` is a device OOM, dump the ledger to stderr and the event
+    log (and bump ``mem.oom_dumps``) so the post-mortem has the peak table
+    and live census from the moment of death. Returns True when it fired;
+    callers re-raise either way. Never raises itself."""
+    try:
+        if not is_oom(exc):
+            return False
+        text = ledger_text()
+        sys.stderr.write(
+            f"[mxnet_tpu] OOM at dispatch site {site!r}: {exc}\n{text}\n")
+        sys.stderr.flush()
+        from . import EVENTS, REGISTRY
+
+        REGISTRY.counter("mem.oom_dumps").inc()
+        EVENTS.emit("mem.oom", site=site, error=str(exc)[:500],
+                    ledger=text[:8000])
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,156 @@
+"""Training-numerics health monitor (host half).
+
+The device half lives in ``train_step.py``: when ``MXTPU_NUMERICS`` is
+``cheap`` (default) or ``full``, the compiled step also emits a health
+tuple — global grad-norm and per-layer-group nonfinite counts, plus
+max-abs parameter update and per-group grad norms in ``full`` — computed
+INSIDE the program (inside the K-step scan under multi-step), riding the
+existing losses/overflow readback so dispatches/step is unchanged.
+``cheap`` folds its reductions into the overflow finiteness pass the
+program pays anyway; ``full`` adds extra per-tensor traversals. ``off``
+leaves the program untouched.
+
+This module keeps the host-side state: per-step gauges
+(``train.grad_norm``, ``train.max_abs_update``), the
+``train.nonfinite_steps`` counter, consecutive-nonfinite tracking with a
+``/healthz`` check (unhealthy after ``MXTPU_NUMERICS_UNHEALTHY_N``
+consecutive nonfinite steps), and NaN provenance — the first offending
+(layer-group, inner-step) of the current nonfinite run, so a blow-up
+inside a K-step scan names its source.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["mode", "record_step_health", "numerics_report",
+           "reset_numerics", "unhealthy_threshold"]
+
+_MODES = ("off", "cheap", "full")
+
+_LOCK = threading.Lock()
+_STATE = {
+    "mode": None,            # mode of the program that last reported
+    "steps": 0,              # optimizer steps observed (inner steps count)
+    "nonfinite_steps": 0,
+    "consecutive_nonfinite": 0,
+    "grad_norm": None,       # last step's global grad norm
+    "max_abs_update": None,
+    "provenance": None,      # (group, inner_step) opening the current run
+    "groups": (),            # layer-group labels of the reporting program
+    "group_nonfinite": {},   # group label -> total nonfinite steps
+    "group_grad_norms": None,  # full mode: last step's per-group norms
+}
+_HEALTH_REGISTERED = [False]
+
+
+def mode() -> str:
+    """``MXTPU_NUMERICS`` (off|cheap|full), default cheap. Read at program
+    build time — sticky per compiled program."""
+    m = os.environ.get("MXTPU_NUMERICS", "cheap").strip().lower()
+    return m if m in _MODES else "cheap"
+
+
+def unhealthy_threshold() -> int:
+    try:
+        return max(1, int(os.environ.get("MXTPU_NUMERICS_UNHEALTHY_N", "3")))
+    except ValueError:
+        return 3
+
+
+def _health_check():
+    with _LOCK:
+        bad = _STATE["consecutive_nonfinite"]
+        prov = _STATE["provenance"]
+    n = unhealthy_threshold()
+    if bad >= n:
+        where = f" (first at group={prov[0]!r} inner_step={prov[1]})" \
+            if prov else ""
+        return False, f"numerics_unhealthy: {bad} consecutive nonfinite " \
+                      f"steps (threshold {n}){where}"
+    return True, f"consecutive_nonfinite={bad}"
+
+
+def _ensure_health_check():
+    if _HEALTH_REGISTERED[0]:
+        return
+    _HEALTH_REGISTERED[0] = True
+    try:
+        from . import register_health
+
+        register_health("numerics", _health_check)
+    except Exception:
+        _HEALTH_REGISTERED[0] = False
+
+
+def record_step_health(groups, gnorms, max_upds, nonfin, group_norms=None,
+                       nmode="cheap"):
+    """Fold one dispatch's health readback into the host state.
+
+    groups: layer-group labels (length G). gnorms/max_upds: float arrays
+    of shape [K] (K = inner steps; 1 when single-step). nonfin: int array
+    [K, G]. group_norms: [K, G] in full mode. All already host numpy —
+    the caller reads them back beside the overflow flags it syncs anyway.
+    """
+    _ensure_health_check()
+    from . import REGISTRY
+
+    k_steps = len(gnorms)
+    with _LOCK:
+        st = _STATE
+        st["mode"] = nmode
+        st["groups"] = tuple(groups)
+        for k in range(k_steps):
+            st["steps"] += 1
+            row = nonfin[k]
+            bad = False
+            for gi, g in enumerate(groups):
+                c = int(row[gi])
+                if c > 0:
+                    bad = True
+                    st["group_nonfinite"][g] = \
+                        st["group_nonfinite"].get(g, 0) + 1
+            if bad:
+                st["nonfinite_steps"] += 1
+                if st["consecutive_nonfinite"] == 0:
+                    first = next(gi for gi in range(len(groups))
+                                 if int(row[gi]) > 0)
+                    st["provenance"] = (groups[first], k)
+                st["consecutive_nonfinite"] += 1
+                REGISTRY.counter("train.nonfinite_steps").inc()
+            else:
+                st["consecutive_nonfinite"] = 0
+        st["grad_norm"] = float(gnorms[-1])
+        if nmode == "full":
+            # cheap mode's program emits a constant 0 here (the max|upd|
+            # traversal is full-mode-only); don't report it as a value
+            st["max_abs_update"] = float(max_upds[-1])
+        if group_norms is not None:
+            st["group_grad_norms"] = {
+                g: float(group_norms[-1][gi])
+                for gi, g in enumerate(groups)}
+    REGISTRY.gauge("train.grad_norm").set(st["grad_norm"])
+    if st["max_abs_update"] is not None:
+        REGISTRY.gauge("train.max_abs_update").set(st["max_abs_update"])
+
+
+def numerics_report() -> dict:
+    """Host-side summary of the in-program health monitor."""
+    with _LOCK:
+        st = dict(_STATE)
+        st["group_nonfinite"] = dict(_STATE["group_nonfinite"])
+    ok, detail = _health_check()
+    st["healthy"] = ok
+    st["detail"] = detail
+    st["unhealthy_threshold"] = unhealthy_threshold()
+    if st["mode"] is None:
+        st["mode"] = mode()
+    return st
+
+
+def reset_numerics():
+    with _LOCK:
+        _STATE.update(mode=None, steps=0, nonfinite_steps=0,
+                      consecutive_nonfinite=0, grad_norm=None,
+                      max_abs_update=None, provenance=None, groups=(),
+                      group_nonfinite={}, group_grad_norms=None)

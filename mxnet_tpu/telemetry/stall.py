@@ -194,16 +194,25 @@ class StallMonitor:
         REGISTRY.counter("telemetry.stalls").inc()
         stacks = _format_all_stacks()
         rows = STEPS.report()[-3:]
+        # a stall is often memory pressure in disguise (allocator thrash,
+        # host swap): the ledger rides along in the dump
+        try:
+            from . import memory as _memory
+
+            ledger = _memory.ledger_text()
+        except Exception:  # noqa: BLE001 — the watchdog must not die
+            ledger = "<memory ledger unavailable>"
         sys.stderr.write(
             f"\n[mxtpu stall watchdog] site {hb.name!r} busy "
             f"{busy_s:.1f}s > threshold {threshold_s:.1f}s "
             f"(p99 {hb.intervals.percentile(99)!r}s over "
             f"{hb.intervals.count} beats)\n"
-            f"last step rows: {rows!r}\n{stacks}\n")
+            f"last step rows: {rows!r}\n{ledger}\n{stacks}\n")
         sys.stderr.flush()
         EVENTS.emit("telemetry.stall", kind="instant", site=hb.name,
                     busy_s=busy_s, threshold_s=threshold_s,
                     beats=hb.beats, last_rows=rows,
+                    ledger=ledger[:_EVENT_STACK_CHARS],
                     stacks=stacks[:_EVENT_STACK_CHARS])
 
     def reset(self):

@@ -28,6 +28,7 @@ _ROW_COUNTERS = {
     "psum_bytes": "collective.psum_bytes",
     "flops": "telemetry.flops",
     "bytes_accessed": "telemetry.bytes_accessed",
+    "nonfinite_steps": "train.nonfinite_steps",
 }
 
 _MAX_ROWS = 100_000  # bound memory over arbitrarily long runs
@@ -62,6 +63,7 @@ class StepTracker:
         self._cols = [(col, reg.counter(cname))
                       for col, cname in _ROW_COUNTERS.items()]
         self._g_mfu = reg.gauge("telemetry.mfu")
+        self._g_gnorm = reg.gauge("train.grad_norm")
         self._timers = [m for m in reg if isinstance(m, Timer)]
         self._seen_version = reg.version
 
@@ -90,6 +92,9 @@ class StepTracker:
                                        row["psum_bytes"])
             row["inner_steps"] = inner_steps
             row["dispatches_per_step"] = row["dispatches"] / inner_steps
+            # numerics monitor sample: the last dispatch's global grad
+            # norm (0.0 until the monitor reports)
+            row["grad_norm"] = self._g_gnorm.value
             # MFU over the step interval: flops credited since the last
             # mark against wall time x device peak. None on the first row
             # (no interval yet) or without a known peak (CPU unless

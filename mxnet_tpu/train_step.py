@@ -108,10 +108,12 @@ class _Program:
 
     __slots__ = ("fn", "uses_rng", "aux_targets", "n_aux", "sharded",
                  "fsdp", "coll_bytes", "compiled", "flops",
-                 "bytes_accessed", "k", "accum")
+                 "bytes_accessed", "k", "accum", "health_mode",
+                 "health_groups")
 
     def __init__(self, fn, uses_rng, aux_targets, sharded=False, fsdp=False,
-                 coll_bytes=(0, 0, 0), k=None, accum=1):
+                 coll_bytes=(0, 0, 0), k=None, accum=1, health_mode="off",
+                 health_groups=None):
         self.fn = fn
         self.uses_rng = uses_rng
         self.aux_targets = aux_targets
@@ -131,6 +133,11 @@ class _Program:
         # accumulating `accum` microbatches; k=None is the single-step path
         self.k = k
         self.accum = accum
+        # in-program numerics monitor: MXTPU_NUMERICS mode baked into the
+        # trace and the layer-group labels of its nonfinite-count vector
+        # (None = monitor off, program emits no health outputs)
+        self.health_mode = health_mode
+        self.health_groups = health_groups
 
 
 class _ShardedOptState:
@@ -977,6 +984,52 @@ class CompiledTrainStep:
                     "dot outputs), 'full' (save nothing) or 'none' (no "
                     "rematerialization)")
 
+        # --- in-program numerics monitor setup (MXTPU_NUMERICS) ------------
+        # 'off' leaves the program structurally untouched; cheap/full add a
+        # health tuple (grad-norm, max-abs update, per-layer-group nonfinite
+        # counts) as extra outputs riding the same dispatch. cheap folds its
+        # grad stats into the overflow finiteness pass the off program pays
+        # anyway; only full adds genuinely extra traversals (max|update|,
+        # per-group norms).
+        nmode = _telemetry.numerics_mode()
+        monitor = nmode != "off"
+        track_upd = nmode == "full"
+        health_groups = None
+        hg_of = None         # per-tensor path: train position -> group idx
+        bucket_gids = None   # ZeRO-1 path: per-bucket flat group-id vectors
+        if monitor:
+            from .parallel.partition import layer_key
+            if fsdp:
+                # FSDP grads arrive as per-group bucket shards: the groups
+                # ARE the (layer-keyed) health groups
+                health_groups = tuple(layer for layer, _, _, _, _ in groups)
+            else:
+                name_of = {id(p): pname
+                           for pname, p in self.net.collect_params().items()}
+                labels, hg_of, idx_of = [], [], {}
+                for i in train_idx:
+                    nm = name_of.get(id(tr._params[i]), tr._params[i].name)
+                    lk = layer_key(nm)
+                    gi_ = idx_of.get(lk)
+                    if gi_ is None:
+                        gi_ = idx_of[lk] = len(labels)
+                        labels.append(lk)
+                    hg_of.append(gi_)
+                health_groups = tuple(labels)
+            n_hg = len(health_groups)
+            if bucketed:
+                # flat-bucket shards don't align with tensor boundaries: a
+                # static group-id vector (pad rows -> sentinel n_hg) lets a
+                # segment_sum recover exact per-group nonfinite counts
+                import numpy as _onp
+
+                bucket_gids = []
+                for _dt, ks_, bs_ in (buckets or ()):
+                    gv = _onp.full((bs_.padded,), n_hg, _onp.int32)
+                    for k2, off, nsz in zip(ks_, bs_.offsets, bs_.sizes):
+                        gv[off:off + nsz] = hg_of[k2]
+                    bucket_gids.append(gv)
+
         # --- capture the forward+loss graph (the hybridize machinery) ------
         if weighted:
             # trace on PADDED shapes; the per-sample loss vector stays
@@ -1110,15 +1163,43 @@ class CompiledTrainStep:
                 aux = [coll.all_reduce(a, "dp", op="mean") for a in aux]
             return loss_v, tuple(aux), grads
 
+        def _grad_pass(g):
+            # (sum g^2, nonfinite count) in ONE variadic-reduce traversal.
+            # This REPLACES the overflow path's all(isfinite) walk when the
+            # monitor is on (finite == count 0), so the grad-side stats
+            # cost no extra pass over off mode; separate jnp reductions
+            # each re-walk the tensor — XLA:CPU scan bodies don't fuse
+            # sibling reduces (measured ~5x the fused pass at K=16)
+            return jax.lax.reduce(
+                (jnp.square(g.astype(jnp.float32)),
+                 (~jnp.isfinite(g)).astype(jnp.int32)),
+                (jnp.float32(0), jnp.int32(0)),
+                lambda a, b: (a[0] + b[0], a[1] + b[1]),
+                tuple(range(g.ndim)))
+
+        def _upd_pass(nw, w):
+            # max |update|: a genuinely extra traversal of the new/old
+            # weights, so it runs in full mode only (cheap reports 0)
+            return jnp.max(jnp.abs((nw - w).astype(jnp.float32)))
+
         def _per_tensor_update(ws, ss, grads, lrs, wds, ts, rescale):
             # single-device + non-elementwise-mesh path: the original
             # per-tensor unroll
             # overflow = non-finite SCALED grads, the quantity the eager
-            # LossScaler.has_overflow inspects (before unscale)
+            # LossScaler.has_overflow inspects (before unscale). With the
+            # monitor on, the finite verdict comes from the fused stats
+            # pass (finite == zero nonfinite count) instead of a second
+            # all(isfinite) walk.
             finite = jnp.bool_(True)
+            tstats = []
             for g in grads:
-                finite = jnp.logical_and(finite,
-                                         jnp.all(jnp.isfinite(g)))
+                if monitor:
+                    sq, cnt = _grad_pass(g)
+                    tstats.append((sq, cnt))
+                    finite = jnp.logical_and(finite, cnt == 0)
+                else:
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
             overflow = jnp.logical_not(finite)
             new_ws, new_ss = [], []
             for k in range(n_train):
@@ -1139,7 +1220,29 @@ class CompiledTrainStep:
                                for s0, s1 in zip(ss[k], ns))
                 new_ws.append(nw)
                 new_ss.append(ns)
-            return new_ws, new_ss, overflow
+            health = None
+            if monitor:
+                # grads here are already dp-reduced (replicated): plain
+                # per-tensor reductions, no collectives
+                gsq = jnp.float32(0)
+                mx = jnp.float32(0)
+                nf = [jnp.zeros((), jnp.int32) for _ in range(n_hg)]
+                gnsq = [jnp.float32(0) for _ in range(n_hg)] \
+                    if nmode == "full" else None
+                for k in range(n_train):
+                    # sum((g*r)^2) == r^2 * sum(g^2): the rescale factor
+                    # folds in as a scalar after the reduction
+                    sq, cnt = tstats[k]
+                    gsq = gsq + sq
+                    if track_upd:
+                        mx = jnp.maximum(mx, _upd_pass(new_ws[k], ws[k]))
+                    nf[hg_of[k]] = nf[hg_of[k]] + cnt
+                    if gnsq is not None:
+                        gnsq[hg_of[k]] = gnsq[hg_of[k]] + sq
+                r2 = (rescale * rescale).astype(jnp.float32)
+                health = (gsq * r2, mx, jnp.stack(nf)) + \
+                    ((jnp.stack(gnsq) * r2,) if gnsq is not None else ())
+            return new_ws, new_ss, overflow, health
 
         def _bucket_update(ws, ss, grads, lrs, wds, ts, rescale, grad_op):
             """The ZeRO-1 update on flat per-dtype buckets: reduce_scatter
@@ -1160,18 +1263,39 @@ class CompiledTrainStep:
             # reduce each bucket; every replica owns one contiguous slice
             # of the fully-reduced gradient
             gred, finite = [], jnp.bool_(True)
+            bstats = []
             for _, ks, bs in buckets:
                 flat_g = bs.flatten([grads[k] for k in ks])
                 g = coll.reduce_scatter(flat_g, "dp")
                 if grad_op == "mean":
                     g = g / n_dp  # pmean == psum / N, elementwise
                 gred.append(g)
-                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+                if monitor:
+                    # finite verdict folded into the fused stats pass
+                    # (finite == zero nonfinite count): the monitor's
+                    # grad-side reductions replace the all(isfinite) walk
+                    # the off program pays anyway, instead of adding one
+                    sq, cnt = _grad_pass(g)
+                    bstats.append((sq, cnt))
+                    finite = jnp.logical_and(finite, cnt == 0)
+                else:
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
             # each replica saw only its shards: AND the verdicts so the
             # where-select (run on shards) agrees everywhere
             finite = coll.all_reduce(finite.astype(jnp.int32), "dp",
                                      op="min") > 0
             overflow = jnp.logical_not(finite)
+            # health accumulators run on the same disjoint shards the
+            # update touches (pad rows are zero): shard-local reductions +
+            # one tiny all_reduce at the end are exact. Per-group counts
+            # come from a segment_sum over the static group-id vector
+            # (sentinel n_hg absorbs the pad tail).
+            gsq = jnp.float32(0)
+            mx = jnp.float32(0)
+            nf = jnp.zeros((n_hg + 1,), jnp.int32) if monitor else None
+            gnsq = jnp.zeros((n_hg + 1,), jnp.float32) \
+                if monitor and nmode == "full" else None
             new_ws = [None] * n_train
             new_ss = []
             for bi, ((_, ks, bs), g) in enumerate(zip(buckets, gred)):
@@ -1183,15 +1307,48 @@ class CompiledTrainStep:
                 # (the pad region is all-zero and discarded)
                 t_v = bs.spread(ts[ksel], pad_value=1.0) if needs_t else None
                 sl = lambda v: bs.shard_slice(v, "dp")  # noqa: E731
-                nw, ns = _apply_chunk(sl(w_in), ss[bi], g, sl(lr_v),
+                w_sh = sl(w_in)
+                nw, ns = _apply_chunk(w_sh, ss[bi], g, sl(lr_v),
                                       sl(wd_v),
                                       sl(t_v) if needs_t else None,
                                       rescale, overflow)
+                if monitor:
+                    bsq, bad = bstats[bi]
+                    gsq = gsq + bsq
+                    if track_upd:
+                        mx = jnp.maximum(mx, _upd_pass(nw, w_sh))
+                    gid_vec = jnp.asarray(bucket_gids[bi])
+                    # per-group attribution is a scatter-add — ruinously
+                    # slow inside an XLA:CPU scan — so it runs only when
+                    # this bucket actually saw a nonfinite value (the
+                    # group-id shard slice materializes inside the branch
+                    # too); healthy steps pay the predicate + a zeros fill
+                    nf = nf + jax.lax.cond(
+                        bad > 0,
+                        lambda g=g, gv=gid_vec, sl=sl: jax.ops.segment_sum(
+                            (~jnp.isfinite(g)).astype(jnp.int32), sl(gv),
+                            num_segments=n_hg + 1),
+                        lambda: jnp.zeros((n_hg + 1,), jnp.int32))
+                    if gnsq is not None:
+                        gnsq = gnsq + jax.ops.segment_sum(
+                            jnp.square(g.astype(jnp.float32)), sl(gid_vec),
+                            num_segments=n_hg + 1)
                 flat_nw = coll.all_gather(nw, "dp", axis=0, tiled=True)
                 new_ss.append(ns)
                 for k, arr in zip(ks, bs.unflatten(flat_nw)):
                     new_ws[k] = arr
-            return new_ws, tuple(new_ss), overflow
+            health = None
+            if monitor:
+                # SHARD-LOCAL accumulators only: the cross-replica
+                # reduction is deferred to finalize_health so a K-step
+                # scan pays it once per dispatch, not once per inner step
+                # (grad sums pick up the rescale factor as a scalar:
+                # sum((g*r)^2) == r^2 * sum(g^2))
+                r2 = (rescale * rescale).astype(jnp.float32)
+                health = (gsq * r2, mx, nf[:n_hg])
+                if gnsq is not None:
+                    health += (gnsq[:n_hg] * r2,)
+            return new_ws, tuple(new_ss), overflow, health
 
         def _apply_chunk(w_c, st_c, g_c, lr_c, wd_c, t_c, rescale, overflow):
             """Run the recurrence on one flat chunk (a ZeRO-1 bucket shard
@@ -1224,6 +1381,7 @@ class CompiledTrainStep:
             from .parallel import collectives as coll
 
             gred, finite = [], jnp.bool_(True)
+            gstats = []
             for (_, _, ks, bs, sh), g in zip(groups, grads):
                 if sh:
                     if grad_op == "mean":
@@ -1231,12 +1389,35 @@ class CompiledTrainStep:
                 else:
                     g = coll.all_reduce(g, "dp", op=grad_op)
                 gred.append(g)
-                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+                if monitor:
+                    # fused stats pass doubles as the finite verdict
+                    # (finite == zero nonfinite count), replacing the
+                    # all(isfinite) walk the off program pays anyway
+                    sq, cnt = _grad_pass(g)
+                    gstats.append((sq, cnt))
+                    finite = jnp.logical_and(finite, cnt == 0)
+                else:
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
             # each replica inspected only its shards: AND the verdicts so
             # the where-select agrees everywhere
             finite = coll.all_reduce(finite.astype(jnp.int32), "dp",
                                      op="min") > 0
             overflow = jnp.logical_not(finite)
+            # health: sharded groups reduce over disjoint shards (psum'd at
+            # the end); replicated pools see identical full grads on every
+            # replica (no reduction — psumming them would count N times)
+            gsq_sh = jnp.float32(0)
+            gsq_rep = jnp.float32(0)
+            mx = jnp.float32(0)
+            nf_sh = [jnp.zeros((), jnp.int32) for _ in range(n_hg)] \
+                if monitor else None
+            nf_rep = [jnp.zeros((), jnp.int32) for _ in range(n_hg)] \
+                if monitor else None
+            gnsq_sh = [jnp.float32(0) for _ in range(n_hg)] \
+                if monitor and nmode == "full" else None
+            gnsq_rep = [jnp.float32(0) for _ in range(n_hg)] \
+                if monitor and nmode == "full" else None
             new_ws, new_ss = [], []
             for gi, ((_, _, ks, bs, sh), g) in enumerate(zip(groups, gred)):
                 ksel = jnp.asarray(ks)
@@ -1249,9 +1430,35 @@ class CompiledTrainStep:
                     t_v = sl(t_v) if needs_t else None
                 nw, ns = _apply_chunk(ws[gi], ss[gi], g, lr_v, wd_v, t_v,
                                       rescale, overflow)
+                if monitor:
+                    sq, cnt = gstats[gi]
+                    if track_upd:
+                        mx = jnp.maximum(mx, _upd_pass(nw, ws[gi]))
+                    if sh:
+                        gsq_sh = gsq_sh + sq
+                        nf_sh[gi] = nf_sh[gi] + cnt
+                        if gnsq_sh is not None:
+                            gnsq_sh[gi] = gnsq_sh[gi] + sq
+                    else:
+                        gsq_rep = gsq_rep + sq
+                        nf_rep[gi] = nf_rep[gi] + cnt
+                        if gnsq_rep is not None:
+                            gnsq_rep[gi] = gnsq_rep[gi] + sq
                 new_ws.append(nw)
                 new_ss.append(ns)
-            return new_ws, tuple(new_ss), overflow
+            health = None
+            if monitor:
+                # shard-local (sharded + replicated halves kept apart):
+                # finalize_health psums the sharded half once per dispatch
+                # — collectives inside the scan body serialize XLA:CPU's
+                # rendezvous thunks every inner step
+                r2 = (rescale * rescale).astype(jnp.float32)
+                health = (gsq_sh * r2, gsq_rep * r2, mx,
+                          jnp.stack(nf_sh), jnp.stack(nf_rep))
+                if gnsq_sh is not None:
+                    health += (jnp.stack(gnsq_sh) * r2,
+                               jnp.stack(gnsq_rep) * r2)
+            return new_ws, tuple(new_ss), overflow, health
 
         # the dp reduction op is build-static: weighted (padded) batches
         # must SUM their pre-divided local grads, whole batches pmean
@@ -1276,13 +1483,45 @@ class CompiledTrainStep:
                               for g in grads)
             return _per_tensor_update(ws, ss, grads, lrs, wds, ts, rescale)
 
+        def finalize_health(h):
+            # cross-replica reduction of the shard-local health
+            # accumulators, normalized to (gsq, mx, nf[, gnsq]). Applied
+            # ONCE per dispatch — on the [K]-stacked values after the scan
+            # for the multi-step program — because collectives inside the
+            # scan body run XLA:CPU's rendezvous thunks every inner step
+            # (measured 3x step cost at K=16). Elementwise collectives, so
+            # a leading K axis passes straight through.
+            if h is None or mesh is None:
+                return h
+            from .parallel import collectives as coll
+
+            if fsdp:
+                gsq_sh, gsq_rep, mx, nf_sh, nf_rep = h[:5]
+                out = (coll.all_reduce(gsq_sh, "dp", op="sum") + gsq_rep,
+                       coll.all_reduce(mx, "dp", op="max"),
+                       coll.all_reduce(nf_sh, "dp", op="sum") + nf_rep)
+                if len(h) > 5:
+                    out += (coll.all_reduce(h[5], "dp", op="sum") + h[6],)
+                return out
+            if bucketed:
+                out = (coll.all_reduce(h[0], "dp", op="sum"),
+                       coll.all_reduce(h[1], "dp", op="max"),
+                       coll.all_reduce(h[2], "dp", op="sum"))
+                if len(h) > 3:
+                    out += (coll.all_reduce(h[3], "dp", op="sum"),)
+                return out
+            return h  # per-tensor health is computed on psum'd grads
+
         def body(ws, ss, fs, xb, yb, wv, key, lrs, wds, ts, rescale,
                  loss_scale):
             loss_v, aux, grads = grad_part(ws, fs, xb, yb, wv, key,
                                            loss_scale)
-            new_ws, new_ss, overflow = update_part(ws, ss, grads, lrs, wds,
-                                                   ts, rescale)
-            return loss_v, aux, new_ws, new_ss, overflow
+            new_ws, new_ss, overflow, health = update_part(
+                ws, ss, grads, lrs, wds, ts, rescale)
+            if health is None:
+                return loss_v, aux, new_ws, new_ss, overflow
+            return loss_v, aux, new_ws, new_ss, overflow, \
+                finalize_health(health)
 
         # shard_map specs shared by the single-step and scanned wrappers
         if mesh is not None:
@@ -1363,9 +1602,9 @@ class CompiledTrainStep:
                     # the mean over the G*B super-batch
                     loss_v = loss_v / g
                     grads = tuple(gr / g for gr in grads)
-                new_ws, new_ss, overflow = update_part(ws, ss, grads, lrs,
-                                                       wds, ts, rescale)
-                return loss_v, aux, new_ws, new_ss, overflow
+                new_ws, new_ss, overflow, health = update_part(
+                    ws, ss, grads, lrs, wds, ts, rescale)
+                return loss_v, aux, new_ws, new_ss, overflow, health
 
             def super_fn(ws, ss, fs, xs, ys, keys, lrs_t, wds_t, ts_t,
                          rescale, loss_scale):
@@ -1382,22 +1621,33 @@ class CompiledTrainStep:
                     # per-inner-step hypers indexed by the COMMITTED count
                     # c, not the loop index: an overflow-skipped step must
                     # leave the schedule untouched, exactly the eager skip
-                    loss_v, aux, new_ws, new_ss, ovf = one_step(
+                    loss_v, aux, new_ws, new_ss, ovf, health = one_step(
                         ws_c, ss_c, sub_fs(fs, aux_c), xj, yj, kj,
                         lrs_t[c], wds_t[c], ts_t[c], rescale, loss_scale)
                     if scaler_on:
                         c = c + 1 - ovf.astype(jnp.int32)
                     else:
                         c = c + 1
-                    return (new_ws, new_ss, aux, c), (loss_v, ovf)
+                    # health (when on) stacks to [K, ...] in the scan ys:
+                    # per-inner-step provenance rides the same readback
+                    ys_j = (loss_v, ovf) if health is None \
+                        else (loss_v, ovf, health)
+                    return (new_ws, new_ss, aux, c), ys_j
 
                 carry = (ws, ss, aux0, jnp.zeros((), jnp.int32))
                 proto = (xs[0], ys[0], keys[0])
                 if mesh is not None:
                     carry = match_carry_vma(step, carry, proto,
                                             fallback_axis="dp")
-                (ws, ss, aux, _), (losses, ovfs) = jax.lax.scan(
+                (ws, ss, aux, _), ys_out = jax.lax.scan(
                     step, carry, (xs, ys, keys))
+                if monitor:
+                    losses, ovfs, healths = ys_out
+                    # ONE set of health collectives over the [K]-stacked
+                    # shard-local rows for the whole super-step
+                    return losses, aux, ws, ss, ovfs, \
+                        finalize_health(healths)
+                losses, ovfs = ys_out
                 return losses, aux, ws, ss, ovfs
 
             if mesh is not None:
@@ -1406,7 +1656,9 @@ class CompiledTrainStep:
                     super_fn, mesh,
                     in_specs=(ws_spec, ss_spec, P(), x_sp, x_sp,
                               P(), P(), P(), P(), P(), P()),
-                    out_specs=(P(), P(), out_ws, out_state, P()))
+                    # the health subtree (when on) is replicated: P() prefix
+                    out_specs=(P(), P(), out_ws, out_state, P()) +
+                              ((P(),) if monitor else ()))
             else:
                 inner_multi = super_fn
             m_attrs = attrs + f" k={k} g={g}"
@@ -1429,7 +1681,9 @@ class CompiledTrainStep:
                 in_specs=(ws_spec, ss_spec, P(), dp, dp,
                           dp if weighted else P(),
                           P(), P(), P(), P(), P(), P()),
-                out_specs=(P(), P(), out_ws, out_state, P()))
+                # the health subtree (when on) is replicated: P() prefix
+                out_specs=(P(), P(), out_ws, out_state, P()) +
+                          ((P(),) if monitor else ()))
             if weighted:
                 b = int(x.shape[0])
 
@@ -1488,7 +1742,9 @@ class CompiledTrainStep:
         return _Program(jax.jit(fn, donate_argnums=(0, 1)), uses_rng,
                         aux_targets, sharded=bucketed, fsdp=fsdp,
                         coll_bytes=coll_bytes,
-                        k=k if multi else None, accum=g)
+                        k=k if multi else None, accum=g,
+                        health_mode=nmode,
+                        health_groups=health_groups)
 
     @staticmethod
     def _pad_rows(arr, pad):
@@ -1617,8 +1873,16 @@ class CompiledTrainStep:
             if cost:
                 prog.flops = cost["flops"]
                 prog.bytes_accessed = cost["bytes_accessed"]
+            _telemetry.record_program_memory("train_step", prog.compiled)
+        # admission check + OOM forensics bracket BOTH dispatch paths: a
+        # set lookup when admitted, a ledger dump when the device OOMs
+        _telemetry.check_memory_admission("train_step")
         if not _telemetry.ON:
-            return prog.compiled(*args)
+            try:
+                return prog.compiled(*args)
+            except Exception as e:
+                _telemetry.memory_oom_forensics("train_step", e)
+                raise
         # ONE compiled-program call per (super-)step; this bypasses the
         # invoke() chokepoint, so count the dispatch here
         _telemetry.record_dispatch()
@@ -1633,7 +1897,11 @@ class CompiledTrainStep:
         if prog.fsdp:
             _telemetry.record_fsdp(self._fsdp_layer_bytes)
         with _telemetry.program_timer("train_step"):
-            return prog.compiled(*args)
+            try:
+                return prog.compiled(*args)
+            except Exception as e:
+                _telemetry.memory_oom_forensics("train_step", e)
+                raise
 
     def _writeback(self, prog, new_ws, new_ss, aux):
         """Rebind the program's donated outputs into the host-visible
@@ -1669,6 +1937,24 @@ class CompiledTrainStep:
         for target, arr in zip(prog.aux_targets, aux):
             target._set_data(arr)
 
+    def _record_health(self, prog, health, k_steps):
+        """Fold the program's in-scan health outputs into the host-side
+        numerics monitor. health = (grad_sq_norm, max_abs_update,
+        nonfinite_counts[, group_sq_norms]) — scalars/[G] from the
+        single-step program, [K]/[K, G] stacked from the scan."""
+        import numpy as onp
+
+        gsq = onp.atleast_1d(onp.asarray(health[0], onp.float64))
+        mx = onp.atleast_1d(onp.asarray(health[1], onp.float64))
+        nonfin = onp.asarray(health[2]).reshape(k_steps, -1)
+        gn = None
+        if len(health) > 3:
+            gn = onp.sqrt(onp.asarray(
+                health[3], onp.float64).reshape(k_steps, -1))
+        _telemetry.record_step_health(
+            prog.health_groups, onp.sqrt(gsq), mx, nonfin,
+            group_norms=gn, nmode=prog.health_mode)
+
     def _run(self, prog, x, y):
         import jax.numpy as jnp
         import numpy as onp
@@ -1697,7 +1983,11 @@ class CompiledTrainStep:
         loss_scale = onp.float32(scale)
         out = self._dispatch(prog, (ws, ss, fs, x._data, y._data, key, lrs,
                                     wds, ts, rescale, loss_scale))
-        loss_v, aux, new_ws, new_ss, overflow = out
+        if prog.health_groups is not None:
+            loss_v, aux, new_ws, new_ss, overflow, health = out
+        else:
+            loss_v, aux, new_ws, new_ss, overflow = out
+            health = None
         self._writeback(prog, new_ws, new_ss, aux)
         if scaler is not None:
             ovf = bool(overflow)  # the step's only host sync (1 byte)
@@ -1706,6 +1996,9 @@ class CompiledTrainStep:
             ovf = False
         if not ovf:
             opt._commit_counts(idxs)
+        if health is not None:
+            # a few scalars riding the dispatch the step already paid for
+            self._record_health(prog, health, k_steps=1)
         if _telemetry.ON:
             _telemetry.mark_step()
         from .ndarray.ndarray import NDArray
@@ -1750,7 +2043,11 @@ class CompiledTrainStep:
         loss_scale = onp.float32(scale)
         out = self._dispatch(prog, (ws, ss, fs, x._data, y._data, keys, lrs,
                                     wds, ts, rescale, loss_scale))
-        losses, aux, new_ws, new_ss, ovfs = out
+        if prog.health_groups is not None:
+            losses, aux, new_ws, new_ss, ovfs, healths = out
+        else:
+            losses, aux, new_ws, new_ss, ovfs = out
+            healths = None
         self._writeback(prog, new_ws, new_ss, aux)
         # the super-step's only host sync: the K overflow flags (K bytes)
         t_s0 = _time.perf_counter()
@@ -1762,6 +2059,10 @@ class CompiledTrainStep:
             clean = k
         for _ in range(clean):
             opt._commit_counts(idxs)
+        if healths is not None:
+            # [K]-stacked health rows ride the same dispatch; the overflow
+            # sync above already waited out the device
+            self._record_health(prog, healths, k_steps=k)
         if _telemetry.ON:
             # host cost per trained step, the sync wait excluded (that
             # time is the device computing, not the host dispatching)

@@ -1,0 +1,298 @@
+"""Device-memory ledger + in-program numerics health monitor (ISSUE 17):
+static per-program peaks for every AOT site, the live ledger report,
+pre-dispatch admission warnings, OOM forensics at the dispatch site,
+bitwise parity of the monitored step, NaN provenance inside a K-step
+scan, the /healthz numerics check, and the off-mode zero-cost contract.
+"""
+import json
+import logging
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, telemetry as tm
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.model_zoo import gpt_tiny
+from mxnet_tpu.serve.decode import DecodeEngine
+from mxnet_tpu.telemetry import memory as tmem
+
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    # the memory table deliberately survives tm.reset() (it mirrors
+    # compiled programs, like costs) — these tests reset it explicitly so
+    # each starts from an empty ledger
+    import mxnet_tpu.random as _rnd
+
+    with _rnd._lock:
+        rng_key, rng_pending = _rnd._key, _rnd._pending_seed
+    host_state = _rnd.host_rng.get_state()
+    tm.disable()
+    tm.reset()
+    tmem.reset_memory()
+    yield
+    tm.stop_exporter()
+    tm.disable()
+    tm.reset()
+    tmem.reset_memory()
+    with _rnd._lock:
+        _rnd._key, _rnd._pending_seed = rng_key, rng_pending
+    _rnd.host_rng.set_state(host_state)
+
+
+def _make_data(k, b, d=8):
+    xs = onp.random.randn(k, b, d).astype(onp.float32)
+    ys = onp.random.randint(0, 4, size=(k, b)).astype(onp.float32)
+    return xs, ys
+
+
+def _fresh_step(multi=None, opt="sgd", seed=7):
+    onp.random.seed(seed)
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), opt, {"learning_rate": 0.01})
+    step = tr.compile_step(net, loss_fn, multi_step=multi)
+    return net, step
+
+
+def _weights(net):
+    return {k: p.data().asnumpy() for k, p in net.collect_params().items()}
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.read().decode()
+
+
+# -- static per-program peaks ------------------------------------------------
+def test_program_memory_train_and_serve_sites():
+    """memory_analysis() is captured at compile for the train step and
+    every serve bucket — on CPU, with real byte counts."""
+    _, step = _fresh_step()
+    xs, ys = _make_data(1, 8)
+    step(mx.nd.array(xs[0]), mx.nd.array(ys[0]))
+
+    mx.random.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    pred = net.predictor(example=mx.nd.array(onp.zeros((4, 8), "float32")),
+                         max_batch=4, max_wait_us=0, cache_dir=False)
+    try:
+        pred.submit(onp.zeros(8, "float32")).result(60)
+    finally:
+        pred.close()
+
+    table = tm.program_memory()
+    assert "train_step" in table
+    assert any(site.startswith("serve.bucket") for site in table)
+    for ent in table.values():
+        assert ent["peak_bytes"] > 0
+        assert ent["compiles"] >= 1
+        assert {"argument_bytes", "output_bytes", "temp_bytes"} <= set(ent)
+    # the per-site gauge mirrors the captured peak
+    assert tm.gauge("mem.program_peak_bytes.train_step").value == \
+        table["train_step"]["peak_bytes"]
+
+
+def test_program_memory_decode_sites():
+    """The decode engine's two AOT families (prefill buckets, the decode
+    tick) land in the same static table."""
+    mx.random.seed(11)
+    net = gpt_tiny(vocab_size=50, dropout=0.0, num_layers=1, units=32,
+                   num_heads=4, max_length=32)
+    net.initialize()
+    eng = DecodeEngine(net, num_slots=2, max_len=32, max_prompt_len=8,
+                       prefill_batch=1, cache_dir=False)
+    try:
+        eng.submit([3, 1, 4], max_new_tokens=2).result(timeout=120)
+    finally:
+        eng.close()
+    table = tm.program_memory()
+    assert "serve.decode_tick" in table
+    assert any(site.startswith("serve.prefill_b") for site in table)
+    assert all(ent["peak_bytes"] > 0 for ent in table.values())
+
+
+# -- live ledger -------------------------------------------------------------
+def test_memory_report_ledger_and_gauges(monkeypatch):
+    _, step = _fresh_step()
+    xs, ys = _make_data(1, 8)
+    step(mx.nd.array(xs[0]), mx.nd.array(ys[0]))
+    monkeypatch.setenv("MXTPU_MEM_LIMIT_BYTES", str(1 << 30))
+    rep = tm.memory_report(top_k=3)
+    assert rep["programs"]["train_step"]["peak_bytes"] > 0
+    assert rep["live"]["live_bytes"] > 0 and rep["live"]["count"] > 0
+    assert len(rep["live"]["top"]) <= 3
+    assert rep["live_bytes_high_water"] >= rep["live"]["live_bytes"]
+    assert rep["limit_bytes"] == 1 << 30
+    assert 0.0 < rep["headroom_fraction"] < 1.0
+    assert tm.gauge("mem.live_bytes").value == rep["live"]["live_bytes"]
+    text = tmem.ledger_text()
+    assert "memory ledger" in text and "train_step" in text
+
+
+def test_admission_check_warns_once(caplog):
+    """A program whose static peak exceeds the configured limit warns at
+    its first dispatch — and only there (one set lookup afterwards)."""
+    _, step = _fresh_step()
+    xs, ys = _make_data(2, 8)
+    caplog.set_level(logging.WARNING, logger="mxnet_tpu.telemetry")
+    # 1 byte: any program's peak exceeds free memory
+    import os
+
+    os.environ["MXTPU_MEM_LIMIT_BYTES"] = "1"
+    try:
+        step(mx.nd.array(xs[0]), mx.nd.array(ys[0]))
+        warns = [r for r in caplog.records
+                 if "memory admission" in r.getMessage()]
+        assert len(warns) == 1 and "train_step" in warns[0].getMessage()
+        step(mx.nd.array(xs[1]), mx.nd.array(ys[1]))
+    finally:
+        del os.environ["MXTPU_MEM_LIMIT_BYTES"]
+    warns = [r for r in caplog.records
+             if "memory admission" in r.getMessage()]
+    assert len(warns) == 1  # warn-once until the site recompiles
+    assert any(e["name"] == "mem.admission" for e in tm.events())
+
+
+def test_oom_forensics_dumps_ledger_and_reraises(capsys):
+    """RESOURCE_EXHAUSTED at the dispatch site dumps the ledger to stderr
+    and the event log, bumps mem.oom_dumps, and re-raises."""
+    _, step = _fresh_step()
+    xs, ys = _make_data(2, 8)
+    step(mx.nd.array(xs[0]), mx.nd.array(ys[0]))
+    prog = next(iter(step._cache.values()))
+
+    def boom(*a, **k):
+        raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                           "1234 bytes")
+
+    prog.compiled = boom
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        step(mx.nd.array(xs[1]), mx.nd.array(ys[1]))
+    assert tm.counter("mem.oom_dumps").value == 1
+    err = capsys.readouterr().err
+    assert "OOM at dispatch site 'train_step'" in err
+    assert "memory ledger" in err
+    ev = [e for e in tm.events() if e["name"] == "mem.oom"]
+    assert ev and "train_step" == ev[-1]["site"]
+
+
+# -- numerics monitor --------------------------------------------------------
+def test_numerics_modes_bitwise_parity(monkeypatch):
+    """The monitor only ADDS outputs: weights after 2 scanned super-steps
+    are bitwise identical across off/cheap/full."""
+    onp.random.seed(5)
+    xs, ys = _make_data(4, 8)
+
+    def run(nmode):
+        monkeypatch.setenv("MXTPU_NUMERICS", nmode)
+        net, step = _fresh_step(multi=2)
+        for j in (0, 2):
+            step(mx.nd.array(xs[j:j + 2]), mx.nd.array(ys[j:j + 2]))
+        return _weights(net)
+
+    w_off, w_cheap, w_full = run("off"), run("cheap"), run("full")
+    for name in w_off:
+        assert onp.array_equal(w_off[name], w_cheap[name]), name
+        assert onp.array_equal(w_off[name], w_full[name]), name
+
+
+def test_numerics_report_rides_existing_dispatch(monkeypatch):
+    """cheap mode: grad-norm and per-group counts arrive with ZERO extra
+    dispatches (dispatches/step stays 1/K at multi_step=K) and no
+    max-abs-update (that traversal is full-mode-only); full mode adds
+    max-abs-update and per-group grad norms."""
+    monkeypatch.setenv("MXTPU_NUMERICS", "cheap")
+    _, step = _fresh_step(multi=4)
+    xs, ys = _make_data(4, 8)
+    sx, sy = mx.nd.array(xs), mx.nd.array(ys)
+    step(sx, sy)  # warm up compile outside the measured row
+    tm.enable()
+    tm.reset()  # drop the warmup's health rows (recording isn't gated)
+    step(sx, sy)
+    row = tm.last_step()
+    assert row["inner_steps"] == 4
+    assert row["dispatches_per_step"] == pytest.approx(0.25)
+    rep = tm.numerics_report()
+    assert rep["mode"] == "cheap"
+    assert rep["steps"] == 4 and rep["nonfinite_steps"] == 0
+    assert rep["grad_norm"] > 0
+    assert rep["max_abs_update"] is None
+    assert rep["group_grad_norms"] is None
+    assert len(rep["groups"]) >= 1
+    assert tm.gauge("train.grad_norm").value == pytest.approx(
+        rep["grad_norm"])
+
+    monkeypatch.setenv("MXTPU_NUMERICS", "full")
+    tm.reset()
+    _, step = _fresh_step(multi=4)
+    step(sx, sy)
+    rep = tm.numerics_report()
+    assert rep["mode"] == "full"
+    assert rep["max_abs_update"] > 0
+    assert set(rep["group_grad_norms"]) == set(rep["groups"])
+
+
+def test_nan_provenance_names_group_and_inner_step(monkeypatch):
+    """A NaN injected at inner step 2 of a K=4 scan is attributed to
+    (first offending layer-group, inner_step=2), and the consecutive-
+    nonfinite run flips the /healthz numerics check to 503."""
+    monkeypatch.setenv("MXTPU_NUMERICS", "cheap")
+    monkeypatch.setenv("MXTPU_NUMERICS_UNHEALTHY_N", "1")
+    _, step = _fresh_step(multi=4)
+    xs, ys = _make_data(4, 8)
+    xs[2] = onp.nan
+    step(mx.nd.array(xs), mx.nd.array(ys))
+    rep = tm.numerics_report()
+    assert rep["nonfinite_steps"] >= 1
+    group, inner = rep["provenance"]
+    assert inner == 2 and group in rep["groups"]
+    assert rep["group_nonfinite"][group] >= 1
+    assert not rep["healthy"]
+    assert tm.counter("train.nonfinite_steps").value >= 1
+
+    exp = tm.start_exporter(port=0)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(exp.url + "/healthz")
+    assert ei.value.code == 503
+    body = json.loads(ei.value.read().decode())
+    assert body["status"] == "unhealthy"
+    assert "numerics" in body["failing_checks"]
+    tm.stop_exporter()
+
+
+def test_numerics_off_emits_no_health_outputs(monkeypatch):
+    """MXTPU_NUMERICS=off leaves the program structurally untouched: no
+    health metadata on the compiled program, no host-side state."""
+    monkeypatch.setenv("MXTPU_NUMERICS", "off")
+    _, step = _fresh_step(multi=2)
+    xs, ys = _make_data(2, 8)
+    step(mx.nd.array(xs), mx.nd.array(ys))
+    prog = next(iter(step._cache.values()))
+    assert prog.health_groups is None and prog.health_mode == "off"
+    rep = tm.numerics_report()
+    assert rep["steps"] == 0 and rep["grad_norm"] is None
+    assert rep["mode"] == "off"
+
+
+# -- overhead budget ---------------------------------------------------------
+def test_telemetry_overhead_with_numerics_cheap(monkeypatch):
+    """The telemetry_overhead budget (<2%) holds with the default
+    numerics mode explicitly pinned on."""
+    import bench
+
+    monkeypatch.setenv("BENCH_TELEM_SMALL", "1")
+    monkeypatch.setenv("MXTPU_NUMERICS", "cheap")
+    r = bench.bench_telemetry_overhead()
+    assert r["threshold_pct"] == 2.0
+    assert r["value"] < 2.0, r

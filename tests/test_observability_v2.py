@@ -329,7 +329,8 @@ def test_metrics_exporter_scrape_and_health(pred):
     status, ctype, body = _get(url + "/metrics.json")
     assert status == 200 and ctype.startswith("application/json")
     snap = json.loads(body)
-    assert set(snap) == {"ts", "metrics", "program_costs", "stall"}
+    assert set(snap) == {"ts", "metrics", "program_costs", "stall",
+                         "memory", "numerics"}
     assert snap["metrics"]["serve.requests"] >= 1
 
     status, _, body = _get(url + "/healthz")

@@ -27,17 +27,17 @@ def test_make_mesh():
 
 def test_shard_map_collectives():
     _need_devices()
-    import jax
     import jax.numpy as jnp
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.parallel.mesh import shard_map_compat
 
     mesh = parallel.make_mesh({"dp": 8})
 
     def fn(x):
         return parallel.all_reduce(x.sum(), "dp") * jnp.ones_like(x)
 
-    sharded = shard_map(fn, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    sharded = shard_map_compat(fn, mesh, in_specs=P("dp"), out_specs=P("dp"))
     x = jnp.arange(16.0)
     out = sharded(x)
     assert float(out[0]) == x.sum()

@@ -23,7 +23,8 @@ DESIGN = ROOT / "docs" / "DESIGN.md"
 # Histogram("serve.ttft_ms", ...)
 _CREATE = re.compile(
     r"(?:counter|gauge|timer|histogram|Counter|Gauge|Timer|Histogram)\(\s*"
-    r"(f?)([\"'])((?:serve|telemetry|checkpoint|fault|train)\.[^\"']*)\2")
+    r"(f?)([\"'])((?:serve|telemetry|checkpoint|fault|train|mem|numerics)"
+    r"\.[^\"']*)\2")
 
 
 def collect(src_root=None):
@@ -55,8 +56,8 @@ def main():
     missing = missing_names()
     if not missing:
         print(f"metric docs lint: all {len(collect())} "
-              "serve./telemetry./checkpoint./fault./train. names "
-              "documented in docs/DESIGN.md")
+              "serve./telemetry./checkpoint./fault./train./mem./numerics. "
+              "names documented in docs/DESIGN.md")
         return 0
     print("metric names missing from docs/DESIGN.md:", file=sys.stderr)
     for name, sites in sorted(missing.items()):
