@@ -986,7 +986,15 @@ def bench_serve_llm():
     TTFT and per-token latency from the telemetry Histograms, slot
     occupancy, and compile counts — steady-state compiles after warmup()
     must be 0. BENCH_SERVE_LLM_SMALL=1 shrinks clients/model for the
-    not-slow suite."""
+    not-slow suite.
+
+    Decode-v2 variants (CLI flags on ``bench.py serve_llm`` / env):
+    ``--speculate K`` (BENCH_SPECULATE_K) verifies K tokens per tick;
+    ``--prefix-shared PCT`` (BENCH_PREFIX_SHARED) gives PCT%% of clients
+    a shared multi-page prompt prefix so the radix cache skips its
+    re-prefill; ``--paged`` (BENCH_PAGED=1) doubles num_slots while
+    pinning the page pool to the UN-doubled reservation — 2x concurrency
+    at equal KV bytes."""
     import threading
 
     import mxnet_tpu as mx
@@ -1001,7 +1009,19 @@ def bench_serve_llm():
     else:
         CLIENTS, MAX_NEW, SLOTS, UNITS, LAYERS, MAX_LEN, MAX_PROMPT = \
             (64, 16, 16, 64, 2, 128, 48)
+    # generation length knob: the default workload is prefill-heavy
+    # (prompts ~ MAX_PROMPT, few new tokens); raising MAX_NEW makes the
+    # measurement decode-dominated, where per-tick levers (speculation)
+    # show up in wall clock instead of being Amdahl-capped by prefill
+    MAX_NEW = int(os.environ.get("BENCH_MAX_NEW", "") or MAX_NEW)
+    MAX_NEW = min(MAX_NEW, MAX_LEN - MAX_PROMPT)
     VOCAB = 256
+    speculate = int(os.environ.get("BENCH_SPECULATE_K", "0") or 0)
+    prefix_pct = max(0, min(100, int(
+        os.environ.get("BENCH_PREFIX_SHARED", "0") or 0)))
+    paged2x = os.environ.get("BENCH_PAGED", "") == "1"
+    v2 = bool(speculate or prefix_pct or paged2x)
+    PAGE = 8 if small else 16  # v2 variants only; default clamps to max_len
 
     mx.random.seed(23)
     net = gpt_tiny(vocab_size=VOCAB, dropout=0.0, num_layers=LAYERS,
@@ -1011,6 +1031,14 @@ def bench_serve_llm():
     prompts = [[int(t) for t in rs.randint(1, VOCAB,
                                            size=rs.randint(1, MAX_PROMPT))]
                for _ in range(CLIENTS)]
+    if prefix_pct:
+        # a shared "system prompt" covering >= 1 full page, so the radix
+        # cache can map it read-only into every sharer's page table
+        span = max(PAGE, (MAX_PROMPT - 4) // PAGE * PAGE)
+        shared = [int(t) for t in rs.randint(1, VOCAB, size=span)]
+        for i in range(CLIENTS * prefix_pct // 100):
+            tail = 1 + rs.randint(max(1, MAX_PROMPT - span))
+            prompts[i] = shared + prompts[i][:tail]
 
     def drive(worker):
         # identical harness both ways: one thread per client, all released
@@ -1051,10 +1079,18 @@ def bench_serve_llm():
         naive_worker(prompts[0])  # warm the window program
         naive_tps, _ = drive(naive_worker)
 
-        eng = DecodeEngine(net, num_slots=SLOTS, max_len=MAX_LEN,
-                           max_prompt_len=MAX_PROMPT,
-                           prefill_batch=min(SLOTS, 4),
-                           max_queue=2 * CLIENTS, cache_dir=False)
+        slots = SLOTS * 2 if paged2x else SLOTS
+        kw = dict(num_slots=slots, max_len=MAX_LEN,
+                  max_prompt_len=MAX_PROMPT, prefill_batch=min(slots, 4),
+                  max_queue=2 * CLIENTS, cache_dir=False)
+        if v2:
+            kw.update(page_tokens=PAGE, speculate_k=max(1, speculate),
+                      prefix_cache=True)
+        if paged2x:
+            # equal-bytes contract: the pool stays at the UN-doubled
+            # slot reservation while num_slots doubles
+            kw["kv_pages"] = SLOTS * (-(-MAX_LEN // PAGE))
+        eng = DecodeEngine(net, **kw)
         eng.warmup()
         compiles_warmup = int(telemetry.metrics()["jit.compiles"])
         # greedy parity spot check before timing anything
@@ -1101,6 +1137,18 @@ def bench_serve_llm():
             "shed": st["shed"], "evicted": st["evicted"],
             "compiles_warmup": compiles_warmup,
             "compiles_steady": compiles_steady,
+            "speculate_k": st["speculate_k"],
+            "spec_accept_mean": (round(st["spec_accept_mean"], 3)
+                                 if "spec_accept_mean" in st else None),
+            "prefix_shared_pct": prefix_pct,
+            "prefix_hit_tokens": st["prefix_hit_tokens"],
+            "prompt_tokens": sum(len(p) for p in prompts),
+            "page_tokens": st["page_tokens"],
+            "kv_pages": st["kv_pages"],
+            "num_slots": st["num_slots"],
+            "paged_2x_slots": paged2x,
+            "page_starved": st["page_starved"],
+            "kv_cache_bytes": st["cache_bytes"],
             "achieved_flops_per_sec": round(achieved, 1),
             "peak_flops_source": _peak_source(),
             "memory": mem,
@@ -1244,6 +1292,18 @@ def main():
         i = sys.argv.index("--multi-step")
         if len(sys.argv) > i + 1 and sys.argv[i + 1].isdigit():
             os.environ["BENCH_MULTI_STEP"] = sys.argv[i + 1]
+    if which == "serve_llm":
+        argv = sys.argv[2:]
+        if "--speculate" in argv:
+            i = sys.argv.index("--speculate")
+            if len(sys.argv) > i + 1 and sys.argv[i + 1].isdigit():
+                os.environ["BENCH_SPECULATE_K"] = sys.argv[i + 1]
+        if "--prefix-shared" in argv:
+            i = sys.argv.index("--prefix-shared")
+            if len(sys.argv) > i + 1 and sys.argv[i + 1].isdigit():
+                os.environ["BENCH_PREFIX_SHARED"] = sys.argv[i + 1]
+        if "--paged" in argv:
+            os.environ["BENCH_PAGED"] = "1"
     import functools
 
     result = {"metric": which, "value": 0.0, "unit": "",

@@ -281,6 +281,220 @@ class GPTModel(HybridBlock):
         return (np.squeeze(logits, axis=1),
                 np.stack(nk, axis=1), np.stack(nv, axis=1))
 
+    # -- paged incremental decode (vLLM-style page pool) ---------------------
+    #
+    # The paged variants replace the per-slot [max_len] reservation with a
+    # shared pool of fixed-size pages, each [page_tokens] positions of one
+    # layer-stack:  pool shape [num_pages, layers, heads, page_tokens,
+    # head_dim].  A slot's cache is an int32 page-table ROW of width
+    # W+1 = ceil(max_len/page_tokens)+1 mapping logical page index ->
+    # pool page id; the sentinel id ``num_pages`` (one past the pool)
+    # marks unmapped columns.  Reads gather the row's first W columns into
+    # a contiguous [W*P] view (sentinel clips to a real page whose
+    # positions the kv mask always excludes); writes scatter through
+    # one-hot einsums — ``one_hot(sentinel, num_pages)`` is the zero
+    # vector, so writes routed at an unmapped column vanish exactly
+    # instead of corrupting a live page.  All three programs keep fully
+    # static shapes, preserving the zero-recompile serving contract.
+
+    def init_paged_cache(self, num_pages, page_tokens):
+        """Preallocated paged KV pool pair, each
+        [num_pages, layers, heads, page_tokens, head_dim]."""
+        from ... import numpy as np
+
+        d = self._units // self._num_heads
+        shape = (int(num_pages), self._num_layers, self._num_heads,
+                 int(page_tokens), d)
+        return (np.zeros(shape, dtype=self._dtype),
+                np.zeros(shape, dtype=self._dtype))
+
+    def _pool_layer(self, pool, i):
+        """[NP, L, H, P, D] -> layer i's [NP, H, P, D]."""
+        from ... import numpy as np
+
+        return np.squeeze(
+            npx.slice_axis(pool, axis=1, begin=i, end=i + 1), axis=1)
+
+    def _gather_page_view(self, pool_layer, flat_ids, W):
+        """Gather page-table rows (W columns each, flattened into
+        ``flat_ids``) from one layer's pool into a contiguous
+        (rows, W*P, units) kv view. Batch-polymorphic: one traced graph
+        serves every batch bucket, so no reshape may bake the row count."""
+        from ... import numpy as np
+
+        NP_, H, P, D = pool_layer.shape
+        view = np.take(pool_layer, flat_ids, axis=0, mode="clip")
+        view = np.transpose(np.reshape(view, (-1, W, H, P, D)),
+                            (0, 1, 3, 2, 4))
+        return np.reshape(view, (-1, W * P, H * D))
+
+    def _scatter_pages(self, k, v, valid_length, start, page_table,
+                       k_pool, v_pool):
+        """Write per-layer prompt k/v (B, layers, heads, T, head_dim) into
+        the pool at the pages ``page_table`` maps for logical pages
+        ``start//P + j``; chunks past ``valid_length`` (and any chunk
+        whose table column is the sentinel) are dropped exactly."""
+        from ... import numpy as np
+
+        NP_, L, H, P, D = k_pool.shape
+        T = k.shape[3]
+        W = page_table.shape[1] - 1
+        J = -(-T // P)
+        pad = J * P - T
+        if pad:
+            widths = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
+            k, v = np.pad(k, widths), np.pad(v, widths)
+        # (B, L, H, J*P, D) -> (B, L, H, J, P, D) page chunks; -1 keeps
+        # the graph batch-polymorphic across compile-time batch buckets
+        k = np.reshape(k, (-1, L, H, J, P, D))
+        v = np.reshape(v, (-1, L, H, J, P, D))
+        j_idx = np.arange(J, dtype="int32").reshape(1, J)
+        # (valid * 0, not zeros_like: stays an op ON the input, so the
+        # traced graph keeps the batch dim symbolic across buckets)
+        base = (start.astype("int32") // P).reshape(-1, 1) if start is not None \
+            else (valid_length.astype("int32") * 0).reshape(-1, 1)
+        col = np.minimum(base + j_idx, W)
+        page_id = np.take_along_axis(page_table, col, axis=1)   # (B, J)
+        live = (j_idx * P < valid_length.astype("int32").reshape(-1, 1))
+        page_oh = np.one_hot(page_id, NP_, dtype=str(k_pool.dtype)) \
+            * live.astype(str(k_pool.dtype)).reshape(-1, J, 1)   # (B, J, NP)
+        wrote = np.einsum("bjp->p", page_oh).reshape(NP_, 1, 1, 1, 1) > 0
+        ck = np.einsum("bjp,blhjod->plhod", page_oh, k)
+        cv = np.einsum("bjp,blhjod->plhod", page_oh, v)
+        return np.where(wrote, ck, k_pool), np.where(wrote, cv, v_pool)
+
+    def forward_prefill_paged(self, tokens, valid_length, page_table,
+                              k_pool, v_pool):
+        """Whole-prompt prefill into a paged pool (prompts starting at
+        position 0 — the no-shared-prefix case).
+
+        Runs the EXACT flash-path compute of ``forward_prefill`` (the
+        last-valid logits are bitwise those of the slot-cache engine);
+        only the cache write changes, scattering page-sized k/v chunks at
+        the pages ``page_table`` (B, W+1) maps.
+        Returns (last_logits (B, V), k_pool', v_pool').
+        """
+        last, k, v = self.forward_prefill(tokens, valid_length)
+        k_pool, v_pool = self._scatter_pages(
+            k, v, valid_length, None, page_table, k_pool, v_pool)
+        return last, k_pool, v_pool
+
+    def forward_prefill_join(self, tokens, valid_length, start, page_table,
+                             k_pool, v_pool):
+        """Suffix prefill joining a cached prefix at page-aligned offset
+        ``start`` (B,): the radix prefix-cache hit path.
+
+        ``tokens`` (B, T) holds only the prompt SUFFIX (right-padded,
+        ``valid_length`` real tokens); positions start..start+T-1. Each
+        query attends the gathered page view (prefix k/v already in the
+        pool) plus this suffix's own k/v, masked to absolute positions
+        <= its own. Suffix k/v then scatters into pages start//P + j.
+        Returns (last_logits (B, V), k_pool', v_pool').
+        """
+        from ... import numpy as np
+
+        NP_, L, H, P, D = k_pool.shape
+        B, T = tokens.shape
+        W = page_table.shape[1] - 1
+        WP = W * P
+        start = start.astype("int32")
+        pos = start.reshape(-1, 1) + np.arange(T, dtype="int32").reshape(1, T)
+        x = self._embed(tokens, np.minimum(pos, self.max_length - 1))
+        ar = np.arange(WP, dtype="int32").reshape(1, 1, WP)
+        mask = (ar <= pos.reshape(-1, T, 1)).reshape(-1, 1, T, WP)
+        pos_oh = np.one_hot(pos, WP, dtype=self._dtype)          # (B, T, WP)
+        wrote = np.einsum("btl->bl", pos_oh).reshape(-1, WP, 1) > 0
+        flat_ids = np.reshape(
+            npx.slice_axis(page_table, axis=1, begin=0, end=W), (-1,))
+        ks, vs = [], []
+        for i, blk in enumerate(self.blocks):
+            q, k, v = blk._qkv(x)
+            ks.append(self._split_heads(k))
+            vs.append(self._split_heads(v))
+            viewk = self._gather_page_view(
+                self._pool_layer(k_pool, i), flat_ids, W)
+            viewv = self._gather_page_view(
+                self._pool_layer(v_pool, i), flat_ids, W)
+            viewk = np.where(wrote, np.einsum("btl,btu->blu", pos_oh, k),
+                             viewk)
+            viewv = np.where(wrote, np.einsum("btl,btu->blu", pos_oh, v),
+                             viewv)
+            attn = npx.multihead_attention(q, viewk, viewv, mask=mask,
+                                           num_heads=self._num_heads,
+                                           causal=False)
+            x = blk._post_attention(x, attn)
+        x = self.ln_f(x)
+        logits = self._lm_logits(x)                              # (B, T, V)
+        onehot = np.one_hot(valid_length.astype("int32") - 1, T,
+                            dtype=str(logits.dtype))
+        last = np.einsum("btv,bt->bv", logits, onehot)
+        k_pool, v_pool = self._scatter_pages(
+            np.stack(ks, axis=1), np.stack(vs, axis=1), valid_length,
+            start, page_table, k_pool, v_pool)
+        return last, k_pool, v_pool
+
+    def forward_decode_paged(self, tokens, positions, page_table,
+                             k_pool, v_pool):
+        """One multi-token decode tick against the paged pool.
+
+        tokens : (S, K) int32 — column 0 is each row's last committed
+            token, columns 1..K-1 a draft continuation (K=1: the plain
+            single-token tick).
+        positions : (S,) int32 — column 0's write position (= current
+            length); column i lands at positions + i.
+        page_table : (S, W+1) int32 row per slot (sentinel = num_pages).
+        Returns (logits (S, K, V), k_pool', v_pool') where logits[:, i]
+        scores the token AFTER tokens[:, i] — greedy verification accepts
+        the longest draft prefix that matches argmax(logits).
+        """
+        from ... import numpy as np
+
+        NP_, L, H, P, D = k_pool.shape
+        S, K = tokens.shape
+        W = page_table.shape[1] - 1
+        WP = W * P
+        pos2 = positions.astype("int32").reshape(-1, 1)
+        q_pos = pos2 + np.arange(K, dtype="int32").reshape(1, K)  # (S, K)
+        x = self._embed(tokens, np.minimum(q_pos, self.max_length - 1))
+        ar = np.arange(WP, dtype="int32").reshape(1, 1, WP)
+        mask = (ar <= q_pos.reshape(S, K, 1)).reshape(S, 1, K, WP)
+        pos_oh = np.one_hot(q_pos, WP, dtype=self._dtype)         # (S, K, WP)
+        wrote = np.einsum("skl->sl", pos_oh).reshape(S, WP, 1) > 0
+        flat_ids = np.reshape(
+            npx.slice_axis(page_table, axis=1, begin=0, end=W), (-1,))
+        # pool write routing (shared by every layer)
+        page_slot = np.minimum(q_pos // P, W)
+        page_id = np.take_along_axis(page_table, page_slot, axis=1)
+        page_oh = np.one_hot(page_id, NP_, dtype=self._dtype)     # (S, K, NP)
+        off_oh = np.one_hot(q_pos % P, P, dtype=self._dtype)      # (S, K, P)
+        cells = np.einsum("skp,sko->po", page_oh, off_oh)
+        cell_mask = cells.reshape(NP_, 1, 1, P, 1) > 0
+        nk, nv = [], []
+        for i, blk in enumerate(self.blocks):
+            q, k, v = blk._qkv(x)
+            nk.append(np.reshape(k, (S, K, H, D)))
+            nv.append(np.reshape(v, (S, K, H, D)))
+            viewk = self._gather_page_view(
+                self._pool_layer(k_pool, i), flat_ids, W)
+            viewv = self._gather_page_view(
+                self._pool_layer(v_pool, i), flat_ids, W)
+            viewk = np.where(wrote, np.einsum("skl,sku->slu", pos_oh, k),
+                             viewk)
+            viewv = np.where(wrote, np.einsum("skl,sku->slu", pos_oh, v),
+                             viewv)
+            attn = npx.multihead_attention(q, viewk, viewv, mask=mask,
+                                           num_heads=self._num_heads,
+                                           causal=False)
+            x = blk._post_attention(x, attn)
+        x = self.ln_f(x)
+        logits = self._lm_logits(x)                               # (S, K, V)
+        knew = np.stack(nk, axis=1)                               # (S,L,K,H,D)
+        vnew = np.stack(nv, axis=1)
+        ck = np.einsum("skp,sko,slkhd->plhod", page_oh, off_oh, knew)
+        cv = np.einsum("skp,sko,slkhd->plhod", page_oh, off_oh, vnew)
+        return (logits, np.where(cell_mask, ck, k_pool),
+                np.where(cell_mask, cv, v_pool))
+
     # -- generation ----------------------------------------------------------
     def _sample(self, logits, temperature):
         from ... import numpy as np

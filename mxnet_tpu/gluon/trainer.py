@@ -363,7 +363,9 @@ class Trainer:
                         new_ss[k] = tuple(p[si] for p in parts[1:])
                 return new_ws, new_ss
 
-            fused = jax.jit(multi_step, donate_argnums=(0, 1))
+            from ..train_step import train_donate_argnums
+            fused = jax.jit(multi_step,
+                            donate_argnums=train_donate_argnums())
             self._fused_fn[key] = fused
         ws = [self._params[i].data()._data for i in idxs]
         ss = [tuple(self._states[i][k]._data for k in state_keys)

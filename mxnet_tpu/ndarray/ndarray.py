@@ -133,7 +133,16 @@ class NDArray:
     def asnumpy(self) -> onp.ndarray:
         """Blocking copy to host (reference: NDArray::SyncCopyToCPU)."""
         try:
-            return onp.asarray(self._data)
+            out = onp.asarray(self._data)
+            if not out.flags.owndata:
+                # On CPU backends onp.asarray is a zero-copy VIEW of the
+                # device buffer. Donated-buffer programs (the compiled
+                # train step, the decode tick) alias and overwrite such
+                # buffers in place, so a view taken here can change under
+                # the caller once the allocator reuses the memory. The
+                # contract is a snapshot — materialize an owned copy.
+                out = out.copy()
+            return out
         except MXNetError:
             raise
         except Exception as e:  # noqa: BLE001

@@ -16,7 +16,7 @@ import numpy as onp
 
 from ...base import MXNetError
 
-__all__ = ["SlotAllocator", "KVCache"]
+__all__ = ["SlotAllocator", "KVCache", "PageAllocator", "PagedKVCache"]
 
 
 class SlotAllocator:
@@ -93,3 +93,106 @@ class KVCache:
 
     def occupancy(self):
         return len(self.slots.live) / self.num_slots
+
+
+class PageAllocator:
+    """LIFO free list over ``num_pages`` KV-pool page ids.
+
+    ``alloc(n)`` is all-or-nothing: it hands back n page ids or None when
+    the pool can't cover the request — the scheduler decides whether to
+    evict prefix-cache pages, wait for retirements, or shed. Exhaustion
+    is therefore a scheduling outcome, never an exception mid-tick."""
+
+    def __init__(self, num_pages):
+        if num_pages < 1:
+            raise MXNetError(f"need at least one page, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free = list(range(self.num_pages - 1, -1, -1))
+        self._live = set()
+
+    def alloc(self, n=1):
+        """Claim ``n`` page ids (all-or-nothing); None when short."""
+        if n < 0:
+            raise MXNetError(f"cannot alloc {n} pages")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._live.update(ids)
+        return ids
+
+    def free(self, ids):
+        for pid in ids:
+            if pid not in self._live:
+                raise MXNetError(
+                    f"page {pid} is not live (double free?)")
+            self._live.remove(pid)
+            self._free.append(pid)
+
+    @property
+    def live(self):
+        return frozenset(self._live)
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    def __len__(self):
+        return self.num_pages
+
+
+class PagedKVCache:
+    """Device-resident paged KV pool pair + the host page tables.
+
+    The pool pair has shape ``[num_pages, layers, heads, page_tokens,
+    head_dim]``; a slot's cache is one int32 page-table row of width
+    ``W+1`` (W = ceil(max_len / page_tokens)) mapping logical page index
+    to pool page id. ``trash`` (= num_pages, one past the pool) marks
+    unmapped columns: in-program, ``one_hot(trash, num_pages)`` is the
+    zero vector so writes routed there vanish, and gathers clip to a real
+    page whose positions the kv mask never admits. Column W is
+    permanently trash — it absorbs the (clipped) routing of speculative
+    writes past the slot's capacity. Memory now scales with live tokens:
+    ``nbytes`` at equal capacity shrinks by the pool/reservation ratio,
+    and a pool sized below num_slots * W oversubscribes capacity safely
+    (admission sheds, ticks starve-retire — never crash).
+    """
+
+    def __init__(self, shape, dtype="float32", *, num_slots, max_len):
+        import jax.numpy as jnp
+
+        shape = tuple(int(d) for d in shape)
+        if len(shape) != 5:
+            raise MXNetError(
+                "paged KV pool shape must be [num_pages, layers, heads, "
+                f"page_tokens, head_dim], got {shape}")
+        self.num_pages = shape[0]
+        self.page_tokens = shape[3]
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.pages_per_slot = -(-self.max_len // self.page_tokens)  # W
+        self.trash = self.num_pages
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.lengths = onp.zeros(self.num_slots, dtype="int32")
+        # host page tables, one row per slot; column W stays trash
+        self.table = onp.full((self.num_slots, self.pages_per_slot + 1),
+                              self.trash, dtype="int32")
+        self.slots = SlotAllocator(self.num_slots)
+        self.pages = PageAllocator(self.num_pages)
+
+    def rebind(self, k, v):
+        self.k, self.v = k, v
+
+    def reset_row(self, sid):
+        self.table[sid, :] = self.trash
+        self.lengths[sid] = 0
+
+    @property
+    def nbytes(self):
+        return int(self.k.size * self.k.dtype.itemsize * 2)
+
+    def occupancy(self):
+        return len(self.slots.live) / self.num_slots
+
+    def pages_live(self):
+        return self.num_pages - self.pages.free_count

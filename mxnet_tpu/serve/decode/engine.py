@@ -1,12 +1,27 @@
-"""DecodeEngine: continuous batching over the two decode program families.
+"""DecodeEngine: continuous batching over the three decode program families.
 
 The host never computes on tensors — each scheduler tick it only feeds
-operands (token ids, positions, slot routing vectors) to one of the two
+operands (token ids, positions, page-table rows) to one of the three
 AOT executables and applies bookkeeping to the results:
 
-    tick:  expire deadlines -> admit pending into free slots (prefill
-           program, bucketed batch x length) -> one decode_tick for ALL
-           slots -> emit tokens / retire finished requests
+    tick:  expire deadlines -> admit pending into free slots (radix
+           prefix lookup, page allocation, then the prefill or
+           prefix-join program, bucketed batch x length) -> one
+           decode_tick_k for ALL slots (K-1 drafted tokens verified per
+           slot when speculation is on) -> commit the accepted prefix /
+           retire finished requests
+
+KV memory is PAGED (vLLM-style): a shared pool of
+``page_tokens``-position pages backs every slot through per-slot page
+tables, so resident bytes scale with live tokens and the pool may be
+sized below num_slots * max_len (oversubscription sheds at admission or
+starve-retires mid-flight — never crashes). A radix prefix cache maps
+previously prefilled prompt prefixes to refcounted pages; a hit maps the
+shared pages read-only into the new slot's table and prefills only the
+suffix. Speculation (``MXTPU_SPECULATE_K``) drafts K-1 tokens on the
+host (``MXTPU_DECODE_DRAFT``) and verifies them in one batched pass —
+greedy accept-longest-prefix keeps the committed sequence bitwise equal
+to plain greedy decoding.
 
 ``submit`` is thread-safe and returns a :class:`DecodeStream` — a
 streaming token future: per-token callbacks fire from the scheduler
@@ -42,8 +57,10 @@ from ...base import MXNetError
 from ...telemetry.registry import Histogram
 from ...testing import chaos
 from ..bucketing import pick_bucket
-from .cache import KVCache
+from .cache import PagedKVCache
+from .prefix import RadixPrefixCache
 from .programs import DecodePrograms
+from .spec import accept_longest_prefix, make_draft
 
 __all__ = ["DecodeEngine", "DecodeStream", "ShedError", "EngineDeadError"]
 
@@ -79,7 +96,8 @@ class DecodeStream:
       the full generated-token list (raises if the request was shed).
 
     ``expired`` marks a deadline eviction (partial output), ``truncated``
-    marks a generation clipped by KV-cache capacity.
+    marks a generation clipped by KV capacity (cache length or page-pool
+    starvation).
     """
 
     def __init__(self, prompt, max_new_tokens, deadline, on_token=None):
@@ -146,18 +164,37 @@ class DecodeEngine:
     Parameters
     ----------
     model : GPTModel-like block, optional
-        Must expose ``forward_prefill`` / ``forward_decode`` /
-        ``init_cache``. May be omitted when ``programs`` (e.g. from
-        ``DecodeEngine.from_export``) supplies traced graphs.
+        Must expose ``forward_prefill_paged`` / ``forward_prefill_join``
+        / ``forward_decode_paged`` / ``init_paged_cache``. May be omitted
+        when ``programs`` (e.g. from ``DecodeEngine.from_export``)
+        supplies traced graphs.
     num_slots : int
         Concurrent sequences per decode tick (the fixed decode program
         shape). Default: ``MXTPU_DECODE_SLOTS`` (8).
     max_len : int
-        KV-cache positions per slot. Default: ``model.max_length``.
+        KV positions per slot (page-table capacity). Default:
+        ``model.max_length``.
     max_prompt_len : int
         Longest admissible prompt; tops the prefill length ladder.
     prefill_batch : int
         Largest prefill batch; tops the prefill batch ladder.
+    page_tokens : int
+        KV page size in token positions. Default:
+        ``MXTPU_KV_PAGE_TOKENS`` (128).
+    kv_pages : int
+        Pool size in pages. Default: ``MXTPU_KV_PAGES``, else
+        num_slots * ceil(max_len / page_tokens) (full reservation).
+        Sizing it lower oversubscribes capacity: bytes stay put while
+        num_slots grows, which is the whole point of paging.
+    speculate_k : int
+        Tokens verified per decode tick; 1 (or ``MXTPU_SPECULATE_K``
+        unset/0) disables speculation.
+    draft : str
+        Draft proposer for speculation: 'ngram' (default) or 'last'
+        (``MXTPU_DECODE_DRAFT``).
+    prefix_cache : bool
+        Radix prefix cache over prompt prefixes. Default:
+        ``MXTPU_PREFIX_CACHE`` (on).
     max_wait_us : int
         Idle-coalesce window before the first prefill of a burst.
         Default: ``MXTPU_DECODE_MAX_WAIT_US`` (2000).
@@ -175,9 +212,11 @@ class DecodeEngine:
     """
 
     def __init__(self, model=None, *, num_slots=None, max_len=None,
-                 max_prompt_len=None, prefill_batch=4, max_wait_us=None,
-                 deadline_ms=None, max_queue=None, cache_dir=None,
-                 manifest=None, programs=None):
+                 max_prompt_len=None, prefill_batch=4, page_tokens=None,
+                 kv_pages=None, speculate_k=None, draft=None,
+                 prefix_cache=None, max_wait_us=None, deadline_ms=None,
+                 max_queue=None, cache_dir=None, manifest=None,
+                 programs=None):
         from ... import telemetry as _tm
         from ...context import enable_compilation_cache
 
@@ -197,6 +236,10 @@ class DecodeEngine:
             max_len = int(manifest_dict["max_len"])
             max_prompt_len = int(manifest_dict["max_prompt_len"])
             prefill_batch = int(manifest_dict["prefill_batch"])
+            page_tokens = int(manifest_dict["page_tokens"])
+            kv_pages = int(manifest_dict["kv_pages"])
+            speculate_k = int(manifest_dict["speculate_k"])
+            prefix_cache = bool(manifest_dict["prefix_cache"])
 
         if programs is not None:
             self.programs = programs
@@ -207,14 +250,34 @@ class DecodeEngine:
                     "export)")
             num_slots = int(num_slots or _env_int("MXTPU_DECODE_SLOTS", 8))
             max_len = int(max_len or model.max_length)
+            page_tokens = int(page_tokens or
+                              _env_int("MXTPU_KV_PAGE_TOKENS", 128))
+            if kv_pages is None:
+                kv_pages = _env_int("MXTPU_KV_PAGES", 0) or None
+            if speculate_k is None:
+                speculate_k = _env_int("MXTPU_SPECULATE_K", 0)
+            speculate_k = max(1, int(speculate_k))
+            if prefix_cache is None:
+                prefix_cache = bool(_env_int("MXTPU_PREFIX_CACHE", 1))
             self.programs = DecodePrograms(
                 model, num_slots=num_slots, max_len=max_len,
                 prefill_batch=prefill_batch,
-                max_prompt_len=max_prompt_len)
+                max_prompt_len=max_prompt_len,
+                page_tokens=page_tokens, kv_pages=kv_pages,
+                speculate_k=speculate_k, prefix_cache=prefix_cache)
         self.num_slots = self.programs.num_slots
         self.max_len = self.programs.max_len
         self.max_prompt_len = self.programs.max_prompt_len
         self.prefill_batch = self.programs.prefill_batch
+        self.page_tokens = self.programs.page_tokens
+        self.kv_pages = self.programs.kv_pages
+        self.speculate_k = self.programs.speculate_k
+        self.prefix_cache = self.programs.prefix_cache
+
+        self._draft = None
+        if self.speculate_k > 1:
+            self._draft = make_draft(
+                draft or os.environ.get("MXTPU_DECODE_DRAFT") or "ngram")
 
         self.max_wait_us = int(max_wait_us if max_wait_us is not None
                                else _env_int("MXTPU_DECODE_MAX_WAIT_US",
@@ -226,9 +289,16 @@ class DecodeEngine:
                              else max(4 * self.num_slots, 16))
 
         # -- device + scheduler state (owned by the worker thread) ---------
-        self._cache = KVCache(self.programs.cache_shape,
-                              self.programs.cache_dtype)
-        self._slot_req = {}   # sid -> DecodeStream
+        self._cache = PagedKVCache(self.programs.cache_shape,
+                                   self.programs.cache_dtype,
+                                   num_slots=self.num_slots,
+                                   max_len=self.max_len)
+        self._prefix = RadixPrefixCache(self.page_tokens) \
+            if self.prefix_cache else None
+        self._slot_req = {}     # sid -> DecodeStream
+        self._slot_pages = {}   # sid -> owned pool page ids
+        self._slot_handles = {}  # sid -> radix pin handles to release
+        self._cols = onp.zeros(self.num_slots, dtype="int32")
         self._last_tok = onp.zeros(self.num_slots, dtype="int32")
 
         self._q = queue.SimpleQueue()
@@ -262,20 +332,24 @@ class DecodeEngine:
         self._n_tokens = 0
         self._n_ticks = 0
         self._n_prefills = 0
+        self._n_starved = 0
+        self._n_prefix_hit_tokens = 0
         self._occupancy_sum = 0.0
         self._pending_count = 0
         self._ttft_ms = Histogram("serve.ttft_ms")
         self._tpot_ms = Histogram("serve.tpot_ms")
+        self._spec_accept = Histogram("serve.spec_accept_len")
 
         if manifest_dict is not None:
             self.warmup()
 
     # ------------------------------------------------------------- warmup
     def warmup(self, manifest_path=None):
-        """Precompile decode_tick + every (batch, len) prefill bucket;
-        optionally write a manifest. After this the scheduler compiles
-        nothing, whatever traffic arrives (asserted via the jit compile
-        counter in tests/test_decode.py). Returns the manifest dict."""
+        """Precompile decode_tick_k + every (batch, len) prefill (and
+        prefix-join) bucket; optionally write a manifest. After this the
+        scheduler compiles nothing, whatever traffic arrives (asserted
+        via the jit compile counter in tests/test_decode.py). Returns the
+        manifest dict."""
         import json
 
         self.programs.warmup()
@@ -422,8 +496,7 @@ class DecodeEngine:
         capped at ``MXTPU_SERVE_RETRY_MAX_MS``; ``point`` is also a chaos
         injection site. Exhaustion re-raises into the crash path."""
         attempt = 0
-        site = "serve.decode_tick" if key[0] == "decode" else \
-            f"serve.prefill_b{key[1]}_t{key[2]}"
+        site = self.programs._site(key)
         self._tm.check_memory_admission(site)
         while True:
             try:
@@ -499,51 +572,132 @@ class DecodeEngine:
                     if st.deadline is not None and now > st.deadline]:
             self._retire(sid, expired=True)
 
-    def _admit(self, pending):
-        while pending and self._cache.slots.free_count:
-            n = min(len(pending), self._cache.slots.free_count,
-                    self.prefill_batch)
-            group = [pending.popleft() for _ in range(n)]
-            try:
-                self._prefill(group)
-            except BaseException:
-                # hand the group back so the crash path fails these
-                # streams with the real error instead of losing them
-                pending.extendleft(reversed(group))
-                raise
+    # ------------------------------------------------------ page admission
+    def _alloc_pages(self, n):
+        """Claim n pool pages, evicting unpinned prefix-cache pages LRU
+        first when the free list is short. None when impossible now."""
+        if n == 0:
+            return []
+        cache = self._cache
+        got = cache.pages.alloc(n)
+        if got is not None:
+            return got
+        if self._prefix is not None:
+            freed = self._prefix.evict(n - cache.pages.free_count)
+            if freed:
+                cache.pages.free(freed)
+                got = cache.pages.alloc(n)
+        return got
 
-    def _prefill(self, group):
+    def _prepare(self, stream):
+        """Prefix lookup + page allocation for one pending stream.
+        Returns the admission meta dict, or None when pages are short
+        (caller decides: wait for retirements or shed)."""
+        P = self.page_tokens
+        plen = len(stream.prompt)
+        if self._prefix is not None:
+            matched, shared, handle = self._prefix.match(stream.prompt)
+        else:
+            matched, shared, handle = 0, [], None
+        need = -(-plen // P) - len(shared)
+        own = self._alloc_pages(need)
+        if own is None:
+            if handle:
+                self._prefix.release(handle)
+            return None
+        return {"start": matched, "shared": shared, "own": own,
+                "handle": handle}
+
+    def _admit(self, pending):
+        cache = self._cache
+        while pending and cache.slots.free_count:
+            group, metas = [], []
+            while (pending and len(group) < self.prefill_batch
+                   and len(group) < cache.slots.free_count):
+                meta = self._prepare(pending[0])
+                if meta is None:
+                    if group or self._slot_req or (
+                            self._prefix is not None
+                            and self._prefix.evictable_pages() > 0):
+                        # pages will free up (retirements / evictions
+                        # racing pins); try again next tick
+                        break
+                    # nothing live, nothing evictable: this prompt can
+                    # never fit — shed it instead of spinning forever
+                    stream = pending.popleft()
+                    self._shed_one(admitted=True)
+                    self._tm.finish_trace(stream.trace, status="shed")
+                    stream._finish(ShedError(
+                        f"kv page pool exhausted: prompt needs "
+                        f"{-(-len(stream.prompt) // self.page_tokens)} "
+                        f"pages, pool has {cache.pages.free_count} free "
+                        f"of {self.kv_pages}"))
+                    continue
+                group.append(pending.popleft())
+                metas.append(meta)
+            if not group:
+                break
+            # plain and join prefills are separate program families —
+            # dispatch each subgroup through its own bucket
+            plain = [(s, m) for s, m in zip(group, metas)
+                     if m["start"] == 0]
+            ext = [(s, m) for s, m in zip(group, metas) if m["start"] > 0]
+            for sub in (plain, ext):
+                if not sub:
+                    continue
+                try:
+                    self._prefill(sub)
+                except BaseException:
+                    # hand the subgroup back so the crash path fails
+                    # these streams with the real error
+                    pending.extendleft(s for s, _ in reversed(sub))
+                    raise
+
+    def _prefill(self, sub):
         import jax
 
         cache = self._cache
-        slots = [cache.slots.alloc() for _ in group]
-        B = pick_bucket(len(group), self.programs.batch_ladder)
-        T = pick_bucket(max(len(s.prompt) for s in group),
+        P = self.page_tokens
+        ext = sub[0][1]["start"] > 0
+        slots = [cache.slots.alloc() for _ in sub]
+        B = pick_bucket(len(sub), self.programs.batch_ladder)
+        T = pick_bucket(max(len(s.prompt) - m["start"] for s, m in sub),
                         self.programs.len_ladder)
         tokens = onp.zeros((B, T), dtype="int32")
         valid = onp.ones((B,), dtype="int32")
-        inv = onp.zeros((self.num_slots,), dtype="int32")
-        hit = onp.zeros((self.num_slots,), dtype=bool)
+        start = onp.zeros((B,), dtype="int32")
+        table = onp.full((B, cache.pages_per_slot + 1), cache.trash,
+                         dtype="int32")
         t_q = time.perf_counter()  # queue phase: submit -> prefill pickup
-        for i, (stream, sid) in enumerate(zip(group, slots)):
-            tokens[i, :len(stream.prompt)] = stream.prompt
-            valid[i] = len(stream.prompt)
-            inv[sid] = i
-            hit[sid] = True
+        for i, ((stream, meta), sid) in enumerate(zip(sub, slots)):
+            row = meta["shared"] + meta["own"]
+            cache.table[sid, :] = cache.trash
+            cache.table[sid, :len(row)] = row
+            self._cols[sid] = len(row)
+            self._slot_pages[sid] = list(meta["own"])
+            self._slot_handles[sid] = [meta["handle"]] if meta["handle"] \
+                else []
+            suffix = stream.prompt[meta["start"]:]
+            tokens[i, :len(suffix)] = suffix
+            valid[i] = len(suffix)
+            start[i] = meta["start"]
+            table[i] = cache.table[sid]
             if stream.trace is not None:
                 stream.trace.mark("queue", t_q)
-        key = ("prefill", B, T)
-        self.programs.ensure("prefill", batch=B, length=T)
+        kind = "prefill_ext" if ext else "prefill"
+        key = (kind, B, T)
+        self.programs.ensure(kind, batch=B, length=T)
         tm = self._tm
         hb_on = tm.ON
         t_run = time.perf_counter()
         if hb_on:
             self._hb_prefill.begin()
         try:
-            outs = self._run_retry(key, [
-                jax.device_put(tokens), jax.device_put(valid),
-                jax.device_put(inv), jax.device_put(hit), cache.k, cache.v],
-                point="decode.prefill")
+            args = [jax.device_put(tokens), jax.device_put(valid)]
+            if ext:
+                args.append(jax.device_put(start))
+            args += [jax.device_put(table), cache.k, cache.v]
+            outs = self._run_retry(key, args, point="decode.prefill")
             cache.rebind(outs[1], outs[2])
             first = onp.asarray(outs[0])  # device sync: the TTFT tokens
         finally:
@@ -555,13 +709,36 @@ class DecodeEngine:
             tm.record_dispatch()
         with self._stats_lock:
             self._n_prefills += 1
-            self._pending_count -= len(group)
-        for i, (stream, sid) in enumerate(zip(group, slots)):
-            cache.lengths[sid] = len(stream.prompt)
+            self._pending_count -= len(sub)
+        for i, ((stream, meta), sid) in enumerate(zip(sub, slots)):
+            plen = len(stream.prompt)
+            cache.lengths[sid] = plen
             self._slot_req[sid] = stream
+            if meta["start"]:
+                with self._stats_lock:
+                    self._n_prefix_hit_tokens += meta["start"]
+                if tm.ON:
+                    tm.REGISTRY.counter("serve.prefix_hit_tokens").inc(
+                        meta["start"])
+                if stream.trace is not None:
+                    stream.trace.extra["prefix_hit_tokens"] = meta["start"]
+            if self._prefix is not None:
+                # publish this prompt's full pages for future sharers;
+                # adopted pages change owner (tree frees them, not us)
+                a0 = meta["start"] // P
+                full = plen // P - a0
+                offered = {a0 + t: meta["own"][t] for t in range(full)}
+                handle, adopted = self._prefix.insert(stream.prompt,
+                                                      offered)
+                if handle:
+                    self._slot_handles[sid].append(handle)
+                if adopted:
+                    keep = [pid for t, pid in enumerate(meta["own"])
+                            if (a0 + t) not in adopted]
+                    self._slot_pages[sid] = keep
             tok = int(first[i])
             self._last_tok[sid] = tok
-            self._emit_token(stream, tok)
+            self._emit_tokens(stream, [tok])
             if len(stream.tokens) >= stream.max_new_tokens:
                 self._retire(sid)
         self._set_slot_gauge()
@@ -570,7 +747,37 @@ class DecodeEngine:
         import jax
 
         cache = self._cache
-        key = ("decode",)
+        P = self.page_tokens
+        K = self.speculate_k
+        W = cache.pages_per_slot
+        live = sorted(self._slot_req)
+        # grow page tables to cover this tick's K write positions; a slot
+        # the pool can't serve is starved: it commits at most one more
+        # token and retires truncated (shed capacity, never crash)
+        starved = set()
+        for sid in live:
+            need = min(-(-(int(cache.lengths[sid]) + K) // P), W)
+            short = need - int(self._cols[sid])
+            if short > 0:
+                got = self._alloc_pages(short)
+                if got is None:
+                    starved.add(sid)
+                else:
+                    c = int(self._cols[sid])
+                    cache.table[sid, c:c + len(got)] = got
+                    self._cols[sid] = c + len(got)
+                    self._slot_pages[sid].extend(got)
+        tokens = onp.zeros((self.num_slots, K), dtype="int32")
+        tokens[:, 0] = self._last_tok
+        drafts = {}
+        if K > 1:
+            for sid in live:
+                stream = self._slot_req[sid]
+                d = self._draft.propose(stream.prompt + stream.tokens,
+                                        K - 1)
+                drafts[sid] = d
+                tokens[sid, 1:] = d
+        key = ("decode", K)
         self.programs.ensure("decode")
         tm = self._tm
         hb_on = tm.ON
@@ -579,11 +786,11 @@ class DecodeEngine:
             self._hb_tick.begin()
         try:
             outs = self._run_retry(key, [
-                jax.device_put(self._last_tok),
-                jax.device_put(cache.lengths), cache.k, cache.v],
+                jax.device_put(tokens), jax.device_put(cache.lengths),
+                jax.device_put(cache.table), cache.k, cache.v],
                 point="decode.tick")
             cache.rebind(outs[1], outs[2])
-            nxt = onp.asarray(outs[0])    # device sync: this tick's tokens
+            rows = onp.asarray(outs[0])   # device sync: this tick's tokens
         finally:
             if hb_on:
                 self._hb_tick.end()
@@ -595,16 +802,32 @@ class DecodeEngine:
         with self._stats_lock:
             self._n_ticks += 1
             self._occupancy_sum += occ
-        for sid in sorted(self._slot_req):
+        for sid in live:
             stream = self._slot_req[sid]
-            cache.lengths[sid] += 1
-            tok = int(nxt[sid])
-            self._last_tok[sid] = tok
-            self._emit_token(stream, tok)
+            m = accept_longest_prefix(drafts[sid], rows[sid]) if K > 1 \
+                else 1
+            if K > 1:
+                self._spec_accept.record(m)
+                if tm.ON:
+                    tm.REGISTRY.histogram("serve.spec_accept_len").record(m)
+            ln = int(cache.lengths[sid])
+            m = min(m, stream.max_new_tokens - len(stream.tokens),
+                    cache.max_len - ln)
+            if sid in starved:
+                m = min(m, 1)
+            toks = [int(t) for t in rows[sid][:m]]
+            cache.lengths[sid] = ln + m
+            self._last_tok[sid] = toks[-1]
+            self._emit_tokens(stream, toks)
             if len(stream.tokens) >= stream.max_new_tokens:
                 self._retire(sid)
-            elif cache.lengths[sid] >= cache.max_len:
+            elif cache.lengths[sid] >= cache.max_len or sid in starved:
                 stream.truncated = True
+                if sid in starved:
+                    with self._stats_lock:
+                        self._n_starved += 1
+                    if tm.ON:
+                        tm.REGISTRY.counter("serve.kv_page_starved").inc()
                 self._retire(sid)
         if tm.ON:
             # tokens/s/chip over a ~0.5 s window (single-device engine:
@@ -619,9 +842,17 @@ class DecodeEngine:
                         (self._n_tokens - n0) / (nowt - t0))
                     self._tps_mark = (nowt, self._n_tokens)
 
-    def _emit_token(self, stream, tok):
+    def _emit_tokens(self, stream, toks):
+        """Emit a committed token run. The first token ever is TTFT; a
+        multi-token (speculative) commit spreads the tick's wall time
+        evenly across its tokens, so TPOT honestly reflects the
+        amortized per-token latency."""
+        if not toks:
+            return
         now = time.perf_counter()
         tm = self._tm
+        n = len(toks)
+        i0 = 0
         if stream._t_last is None:
             ms = (now - stream.t_submit) * 1e3
             self._ttft_ms.record(ms)
@@ -631,23 +862,33 @@ class DecodeEngine:
                 stream.trace.extra["ttft_ms"] = ms
             if tm.ON:
                 tm.REGISTRY.histogram("serve.ttft_ms").record(ms)
-        else:
-            ms = (now - stream._t_last) * 1e3
-            self._tpot_ms.record(ms)
-            if tm.ON:
-                tm.REGISTRY.histogram("serve.tpot_ms").record(ms)
+            i0 = 1
+        if n - i0 > 0:
+            ms = (now - stream._t_last) * 1e3 / (n - i0) \
+                if stream._t_last is not None else 0.0
+            for _ in range(n - i0):
+                self._tpot_ms.record(ms)
+                if tm.ON:
+                    tm.REGISTRY.histogram("serve.tpot_ms").record(ms)
         stream._t_last = now
         with self._stats_lock:
-            self._n_tokens += 1
+            self._n_tokens += n
         if tm.ON:
-            tm.REGISTRY.counter("serve.tokens_total").inc()
-        stream._emit(tok)
+            tm.REGISTRY.counter("serve.tokens_total").inc(n)
+        for tok in toks:
+            stream._emit(tok)
 
     def _retire(self, sid, expired=False):
         cache = self._cache
         stream = self._slot_req.pop(sid)
         cache.slots.free(sid)
-        cache.lengths[sid] = 0
+        cache.reset_row(sid)
+        self._cols[sid] = 0
+        owned = self._slot_pages.pop(sid, [])
+        if owned:
+            cache.pages.free(owned)
+        for handle in self._slot_handles.pop(sid, []):
+            self._prefix.release(handle)
         self._last_tok[sid] = 0
         stream.expired = expired
         if stream.trace is not None:
@@ -678,10 +919,13 @@ class DecodeEngine:
         if self._tm.ON:
             self._tm.REGISTRY.gauge("serve.slots_live").set(
                 len(self._slot_req))
-            # KV-cache residency for the memory ledger (bytes are static
-            # per engine build; the gauge keys the ledger's kv line)
+            # KV residency for the memory ledger: pool bytes are static
+            # per engine build (the gauge keys the ledger's kv line);
+            # pages_live tracks actual token residency inside the pool
             self._tm.REGISTRY.gauge("mem.kv_cache_bytes").set(
                 self._cache.nbytes)
+            self._tm.REGISTRY.gauge("serve.kv_pages_live").set(
+                self._cache.pages_live())
 
     def _drain(self, pending, err=None, status="closed"):
         if err is None:
@@ -720,6 +964,8 @@ class DecodeEngine:
                 "ticks": ticks,
                 "prefills": self._n_prefills,
                 "pending": self._pending_count,
+                "prefix_hit_tokens": self._n_prefix_hit_tokens,
+                "page_starved": self._n_starved,
             }
         p50, p99 = self._ttft_ms.percentiles(50, 99)
         out["ttft_ms_p50"], out["ttft_ms_p99"] = p50, p99
@@ -729,6 +975,16 @@ class DecodeEngine:
         out["slots_live"] = len(self._slot_req)
         out["num_slots"] = self.num_slots
         out["cache_bytes"] = self._cache.nbytes
+        out["page_tokens"] = self.page_tokens
+        out["kv_pages"] = self.kv_pages
+        out["kv_pages_live"] = self._cache.pages_live()
+        out["speculate_k"] = self.speculate_k
+        if self.speculate_k > 1:
+            out["spec_accept_mean"] = self._spec_accept.mean
+            out["tokens_per_tick"] = (out["tokens"] / ticks) if ticks \
+                else 0.0
+        out["prefix_cache"] = self._prefix.stats() \
+            if self._prefix is not None else None
         out["dead"] = self._dead is not None
         out["draining"] = self._draining
         out["programs"] = sorted(

@@ -1,21 +1,28 @@
-"""The two AOT-compiled program families of the decode engine.
+"""The three AOT-compiled program families of the decode engine (v2).
 
-Exactly two graph shapes exist (PyGraph's whole-iteration capture applied
-to decoding — the host only feeds operands):
+Exactly three graph shapes exist (PyGraph's whole-iteration capture
+applied to decoding — the host only feeds operands):
 
 - ``prefill(bucket_batch, bucket_len)``: forward the whole right-padded
-  prompt batch once, argmax the logits at each row's last valid position
-  (the first generated token), and scatter the per-layer k/v into the
-  assigned cache slots (``inv_index``/``hit`` route batch rows to slot
-  rows in-program, so the donated cache is updated without a host-side
-  copy). One traced graph per length bucket, compiled per batch bucket —
-  the program set is O(log max_prompt_len · log prefill_batch).
-- ``decode_tick(num_slots)``: one token for EVERY slot against the full
-  cache — fixed shape, traced and compiled exactly once, so steady state
-  never recompiles regardless of which requests join or leave.
+  prompt batch once (the exact flash-path compute of the plain forward),
+  argmax the logits at each row's last valid position, and scatter the
+  per-layer k/v page-chunk-wise into the pool pages each row's page-table
+  operand maps. One traced graph per length bucket, compiled per batch
+  bucket.
+- ``prefill_ext(bucket_batch, bucket_len)``: the radix prefix-cache join
+  — forward only the prompt SUFFIX from a page-aligned ``start`` offset,
+  attending the gathered page view (shared prefix pages already
+  resident) plus the suffix's own k/v, then scatter the suffix pages.
+  Traced only when the prefix cache is enabled.
+- ``decode_tick_k(num_slots, K)``: K tokens for EVERY slot against the
+  gathered page view — fixed shape, traced and compiled exactly once.
+  K = 1 is the plain tick; K > 1 verifies a K-1-token draft in one
+  batched pass (speculative decoding). Static K keeps the program set
+  fixed, so steady state never recompiles regardless of drafts,
+  prefix hits, or which requests join or leave.
 
-Both families donate the cache pair (cache in, cache out — a single
-device residency; on backends without donation support XLA falls back to
+All three donate the pool pair (pool in, pool out — a single device
+residency; on backends without donation support XLA falls back to
 copying). ``export``/``from_export`` round-trip the traced graphs through
 Symbol JSON + a params npz, so a fresh process can serve without the
 model class — the SymbolBlock.imports analog for the decode engine.
@@ -30,18 +37,23 @@ import warnings
 import numpy as onp
 
 from ...base import MXNetError
-from ..bucketing import bucket_ladder, pick_bucket
+from ..bucketing import bucket_ladder
 
 __all__ = ["DecodePrograms", "load_decode_manifest"]
+
+MANIFEST_VERSION = 2
 
 
 def load_decode_manifest(path):
     with open(path) as fh:
         m = json.load(fh)
-    if m.get("version") != 1 or m.get("kind") != "decode_engine":
+    if m.get("kind") != "decode_engine" or \
+            m.get("version") != MANIFEST_VERSION:
         raise MXNetError(
             f"unsupported decode manifest in {path}: version="
-            f"{m.get('version')!r} kind={m.get('kind')!r}")
+            f"{m.get('version')!r} kind={m.get('kind')!r} (this build "
+            f"reads version {MANIFEST_VERSION}; pre-paging manifests "
+            "must be re-exported)")
     return m
 
 
@@ -63,11 +75,14 @@ class DecodePrograms:
     """
 
     # donated operand indices (example-input space)
-    _PREFILL_DONATE = (4, 5)   # (tokens, valid, inv_index, hit, kc, vc)
-    _DECODE_DONATE = (2, 3)    # (tokens, positions, kc, vc)
+    _PREFILL_DONATE = (3, 4)   # (tokens, valid, table, kp, vp)
+    _EXT_DONATE = (4, 5)       # (tokens, valid, start, table, kp, vp)
+    _DECODE_DONATE = (3, 4)    # (tokens, positions, table, kp, vp)
 
     def __init__(self, model=None, *, num_slots, max_len, prefill_batch=4,
-                 max_prompt_len=None, min_prompt_bucket=8, _from_export=None):
+                 max_prompt_len=None, min_prompt_bucket=8, page_tokens=128,
+                 kv_pages=None, speculate_k=1, prefix_cache=True,
+                 _from_export=None):
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
         self.prefill_batch = int(prefill_batch)
@@ -77,18 +92,39 @@ class DecodePrograms:
                 f"max_prompt_len {max_prompt_len} exceeds cache max_len "
                 f"{self.max_len}")
         self.max_prompt_len = max_prompt_len
+        # clamp to max_len: a page larger than the whole cache row would
+        # silently re-grow per-slot reservation past the slot-cache design
+        self.page_tokens = min(int(page_tokens), self.max_len)
+        if self.page_tokens < 1:
+            raise MXNetError(
+                f"page_tokens must be >= 1, got {page_tokens}")
+        # W: page-table columns per slot (+1 sentinel column in-table)
+        self.pages_per_slot = -(-self.max_len // self.page_tokens)
+        self.kv_pages = int(kv_pages or
+                            self.num_slots * self.pages_per_slot)
+        if self.kv_pages < -(-self.max_prompt_len // self.page_tokens):
+            raise MXNetError(
+                f"kv_pages {self.kv_pages} cannot hold even one "
+                f"max_prompt_len={self.max_prompt_len} prompt at "
+                f"page_tokens={self.page_tokens}")
+        self.speculate_k = max(1, int(speculate_k))
+        if self.speculate_k > self.page_tokens:
+            raise MXNetError(
+                f"speculate_k {self.speculate_k} exceeds page_tokens "
+                f"{self.page_tokens} (a tick must fit in one new page)")
+        self.prefix_cache = bool(prefix_cache)
         self.batch_ladder = bucket_ladder(self.prefill_batch)
         self.len_ladder = bucket_ladder(
             max_prompt_len, min_bucket=min(min_prompt_bucket,
                                            max_prompt_len))
         self._model = model
-        self._cops = {}         # "decode" | "prefill:<T>" -> CachedOp
+        self._cops = {}         # "decode:<K>" | "prefill[_ext]:<T>" -> CachedOp
         self._graph_params = {}  # graph key -> ordered param names
         self._params = {}       # name -> raw device array
-        self._programs = {}     # ("decode",) | ("prefill", B, T) -> Compiled
+        self._programs = {}     # ("decode", K) | ("prefill"[_ext], B, T)
         self._costs = {}        # program key -> (flops, bytes_accessed)
         self._signatures = {}   # str key -> trace signature
-        self.cache_shape = None  # [S, layers, heads, max_len, head_dim]
+        self.cache_shape = None  # [kv_pages, layers, heads, page_tokens, hd]
         self.cache_dtype = "float32"
         if _from_export is not None:
             self._load_export(_from_export)
@@ -96,6 +132,10 @@ class DecodePrograms:
             if model is None:
                 raise MXNetError("DecodePrograms needs a model or an export")
             self._trace_all()
+
+    @property
+    def table_width(self):
+        return self.pages_per_slot + 1
 
     # ----------------------------------------------------------------- trace
     def _collect_params(self):
@@ -110,31 +150,44 @@ class DecodePrograms:
         self._params = {name: arr._data for name, arr in params}
         names = [name for name, _ in params]
         with autograd.pause():
-            self._cops["decode"] = self._trace_decode(params)
-            self._graph_params["decode"] = names
+            K = self.speculate_k
+            self._cops[f"decode:{K}"] = self._trace_decode(K, params)
+            self._graph_params[f"decode:{K}"] = names
             for T in self.len_ladder:
                 self._cops[f"prefill:{T}"] = self._trace_prefill(T, params)
                 self._graph_params[f"prefill:{T}"] = names
+                if self.prefix_cache:
+                    self._cops[f"prefill_ext:{T}"] = \
+                        self._trace_prefill_ext(T, params)
+                    self._graph_params[f"prefill_ext:{T}"] = names
 
-    def _trace_decode(self, params):
+    def _pool_pair(self):
+        kp, vp = self._model.init_paged_cache(self.kv_pages,
+                                              self.page_tokens)
+        if self.cache_shape is None:
+            self.cache_shape = tuple(int(d) for d in kp.shape)
+            self.cache_dtype = str(kp.dtype)
+        return kp, vp
+
+    def _trace_decode(self, K, params):
         from ... import numpy as np
         from ...cached_op import trace
 
         model = self._model
         S = self.num_slots
-        tokens = np.zeros((S,), dtype="int32")
+        tokens = np.zeros((S, K), dtype="int32")
         positions = np.zeros((S,), dtype="int32")
-        kc, vc = model.init_cache(S, self.max_len)
-        self.cache_shape = tuple(int(d) for d in kc.shape)
-        self.cache_dtype = str(kc.dtype)
+        table = np.full((S, self.table_width), self.kv_pages,
+                        dtype="int32")
+        kp, vp = self._pool_pair()
 
-        def fn(t, p, k, v):
-            logits, k2, v2 = model.forward_decode(t, p, k, v)
+        def fn(t, p, tab, k, v):
+            logits, k2, v2 = model.forward_decode_paged(t, p, tab, k, v)
             nxt = np.argmax(logits, axis=-1).astype("int32")
             return nxt, k2, v2
 
-        _, _, cop = trace(fn, [tokens, positions, kc, vc], params)
-        cop._name = "serve_decode_tick"
+        _, _, cop = trace(fn, [tokens, positions, table, kp, vp], params)
+        cop._name = f"serve_decode_tick_k{K}"
         return cop
 
     def _trace_prefill(self, T, params):
@@ -142,32 +195,44 @@ class DecodePrograms:
         from ...cached_op import trace
 
         model = self._model
-        S, B = self.num_slots, self.prefill_batch
+        B = self.prefill_batch
         tokens = np.zeros((B, T), dtype="int32")
         valid = np.ones((B,), dtype="int32")
-        inv_index = np.zeros((S,), dtype="int32")
-        hit = np.zeros((S,), dtype="bool")
-        kc, vc = model.init_cache(S, self.max_len)
-        pad = self.max_len - T
+        table = np.full((B, self.table_width), self.kv_pages,
+                        dtype="int32")
+        kp, vp = self._pool_pair()
 
-        def fn(tok, vl, inv, h, k_cache, v_cache):
-            last, k, v = model.forward_prefill(tok, vl)
+        def fn(tok, vl, tab, k, v):
+            last, k2, v2 = model.forward_prefill_paged(tok, vl, tab, k, v)
             first = np.argmax(last, axis=-1).astype("int32")
-            # route batch rows to their slots: gather-by-inv_index builds
-            # a slot-shaped view of the new k/v, `hit` picks which slot
-            # rows actually change — the rest keep the donated cache
-            sel_k = np.take(k, inv, axis=0, mode="clip")
-            sel_v = np.take(v, inv, axis=0, mode="clip")
-            if pad:
-                widths = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
-                sel_k, sel_v = np.pad(sel_k, widths), np.pad(sel_v, widths)
-            hm = h.reshape(-1, 1, 1, 1, 1)
-            return (first, np.where(hm, sel_k, k_cache),
-                    np.where(hm, sel_v, v_cache))
+            return first, k2, v2
 
-        _, _, cop = trace(fn, [tokens, valid, inv_index, hit, kc, vc],
-                          params)
+        _, _, cop = trace(fn, [tokens, valid, table, kp, vp], params)
         cop._name = f"serve_prefill_{T}"
+        return cop
+
+    def _trace_prefill_ext(self, T, params):
+        from ... import numpy as np
+        from ...cached_op import trace
+
+        model = self._model
+        B = self.prefill_batch
+        tokens = np.zeros((B, T), dtype="int32")
+        valid = np.ones((B,), dtype="int32")
+        start = np.zeros((B,), dtype="int32")
+        table = np.full((B, self.table_width), self.kv_pages,
+                        dtype="int32")
+        kp, vp = self._pool_pair()
+
+        def fn(tok, vl, st, tab, k, v):
+            last, k2, v2 = model.forward_prefill_join(tok, vl, st, tab,
+                                                      k, v)
+            first = np.argmax(last, axis=-1).astype("int32")
+            return first, k2, v2
+
+        _, _, cop = trace(fn, [tokens, valid, start, table, kp, vp],
+                          params)
+        cop._name = f"serve_prefill_ext_{T}"
         return cop
 
     # --------------------------------------------------------------- compile
@@ -176,36 +241,50 @@ class DecodePrograms:
 
         return jnp.zeros(shape, dtype)
 
+    @staticmethod
+    def _site(key):
+        if key[0] == "decode":
+            return f"serve.decode_tick_k{key[1]}"
+        if key[0] == "prefill_ext":
+            return f"serve.prefill_ext_b{key[1]}_t{key[2]}"
+        return f"serve.prefill_b{key[1]}_t{key[2]}"
+
     def ensure(self, kind, batch=None, length=None):
         """Compile (memoized) and return one executable."""
         if kind == "decode":
-            key = ("decode",)
+            key = ("decode", self.speculate_k)
         else:
-            key = ("prefill", int(batch), int(length))
+            key = (kind, int(batch), int(length))
         prog = self._programs.get(key)
         if prog is not None:
             return prog
         from ...telemetry.watchdog import format_signature
 
-        kc = self._zeros(self.cache_shape, self.cache_dtype)
-        vc = self._zeros(self.cache_shape, self.cache_dtype)
+        kp = self._zeros(self.cache_shape, self.cache_dtype)
+        vp = self._zeros(self.cache_shape, self.cache_dtype)
         S = self.num_slots
+        Wt = self.table_width
         if kind == "decode":
-            cop = self._cops["decode"]
-            examples = [self._zeros((S,), "int32"),
-                        self._zeros((S,), "int32"), kc, vc]
+            cop = self._cops[f"decode:{self.speculate_k}"]
+            examples = [self._zeros((S, self.speculate_k), "int32"),
+                        self._zeros((S,), "int32"),
+                        self._zeros((S, Wt), "int32"), kp, vp]
             donate = self._DECODE_DONATE
         else:
-            cop = self._cops.get(f"prefill:{length}")
+            cop = self._cops.get(f"{kind}:{length}")
             if cop is None:
                 raise MXNetError(
-                    f"no prefill graph for length bucket {length} "
-                    f"(ladder: {self.len_ladder})")
+                    f"no {kind} graph for length bucket {length} "
+                    f"(ladder: {self.len_ladder}; prefix_cache="
+                    f"{self.prefix_cache})")
             examples = [self._zeros((batch, length), "int32"),
-                        self._zeros((batch,), "int32"),
-                        self._zeros((S,), "int32"),
-                        self._zeros((S,), "bool"), kc, vc]
-            donate = self._PREFILL_DONATE
+                        self._zeros((batch,), "int32")]
+            if kind == "prefill_ext":
+                examples.append(self._zeros((batch,), "int32"))
+                donate = self._EXT_DONATE
+            else:
+                donate = self._PREFILL_DONATE
+            examples += [self._zeros((batch, Wt), "int32"), kp, vp]
         args = examples + [self._params[n]
                            for n in self._graph_params[self._cop_key(key)]]
         prog = _compile(cop, args, donate)
@@ -214,8 +293,7 @@ class DecodePrograms:
         # the flops counter with it at every dispatch
         from ... import telemetry as _tm
 
-        site = ("serve.decode_tick" if kind == "decode"
-                else f"serve.prefill_b{batch}_t{length}")
+        site = self._site(key)
         cost = _tm.record_program_cost(site, prog)
         _tm.record_program_memory(site, prog)
         self._costs[key] = ((cost["flops"], cost["bytes_accessed"])
@@ -224,9 +302,10 @@ class DecodePrograms:
             [getattr(x, "_data", x) for x in examples])
         return prog
 
-    @staticmethod
-    def _cop_key(key):
-        return "decode" if key[0] == "decode" else f"prefill:{key[2]}"
+    def _cop_key(self, key):
+        if key[0] == "decode":
+            return f"decode:{key[1]}"
+        return f"{key[0]}:{key[2]}"
 
     def run(self, key, datas):
         """Call a compiled program with raw device operands; appends the
@@ -247,12 +326,15 @@ class DecodePrograms:
         return outs if isinstance(outs, (tuple, list)) else (outs,)
 
     def warmup(self):
-        """Compile the whole table: decode_tick + every (batch, len)
-        prefill bucket. After this, serving compiles nothing."""
+        """Compile the whole table: decode_tick_k + every (batch, len)
+        prefill (and prefix-join) bucket. After this, serving compiles
+        nothing."""
         self.ensure("decode")
         for T in self.len_ladder:
             for B in self.batch_ladder:
                 self.ensure("prefill", batch=B, length=T)
+                if self.prefix_cache:
+                    self.ensure("prefill_ext", batch=B, length=T)
 
     # ------------------------------------------------------------- manifests
     def manifest_dict(self, cache_dir=None, graphs=None):
@@ -261,7 +343,7 @@ class DecodePrograms:
         import jax
 
         return {
-            "version": 1,
+            "version": MANIFEST_VERSION,
             "kind": "decode_engine",
             "env_signature": _probe_env_signature(),
             "jax_version": getattr(jax, "__version__", "?"),
@@ -269,6 +351,10 @@ class DecodePrograms:
             "max_len": self.max_len,
             "prefill_batch": self.prefill_batch,
             "max_prompt_len": self.max_prompt_len,
+            "page_tokens": self.page_tokens,
+            "kv_pages": self.kv_pages,
+            "speculate_k": self.speculate_k,
+            "prefix_cache": self.prefix_cache,
             "batch_ladder": list(self.batch_ladder),
             "len_ladder": list(self.len_ladder),
             "cache_shape": list(self.cache_shape or ()),
@@ -280,6 +366,12 @@ class DecodePrograms:
         }
 
     # ---------------------------------------------------------------- export
+    @staticmethod
+    def _n_data(key):
+        if key.startswith("prefill_ext:"):
+            return 6
+        return 5
+
     def export(self, prefix):
         """Write the traced graphs + params + manifest; returns the
         manifest path. A fresh process rebuilds the full program table
@@ -291,7 +383,7 @@ class DecodePrograms:
             fname = f"{prefix}-{key.replace(':', '_')}-symbol.json"
             cop.sym.save(fname)
             graphs[key] = {"file": os.path.basename(fname),
-                           "n_data": 4 if key == "decode" else 6,
+                           "n_data": self._n_data(key),
                            "params": self._graph_params[key]}
         onp.savez(f"{prefix}-params.npz",
                   **{n: onp.asarray(a) for n, a in self._params.items()})
@@ -314,6 +406,9 @@ class DecodePrograms:
         self = cls(num_slots=m["num_slots"], max_len=m["max_len"],
                    prefill_batch=m["prefill_batch"],
                    max_prompt_len=m["max_prompt_len"],
+                   page_tokens=m["page_tokens"], kv_pages=m["kv_pages"],
+                   speculate_k=m["speculate_k"],
+                   prefix_cache=m["prefix_cache"],
                    _from_export=(m, os.path.dirname(os.path.abspath(mpath))))
         return self
 

@@ -103,6 +103,28 @@ from . import telemetry as _telemetry
 __all__ = ["CompiledTrainStep"]
 
 
+def train_donate_argnums():
+    """Donation spec for the whole-step programs: ``(0, 1)`` (weights,
+    optimizer state) on accelerators, ``()`` on XLA:CPU.
+
+    Buffer donation is the TPU memory win (update in place instead of
+    holding two copies of params + state). On the CPU backend it buys
+    nothing — host RAM is not the constraint — and XLA:CPU's donation
+    aliasing is unsound under the multi-device host mesh: donated buffers
+    can be freed while an aliased output chain still lives on them, and
+    once the heap reuses the memory the live weights/state get scribbled
+    (nondeterministic NaN/garbage a few steps later; reproduced by
+    tests/test_multi_step.py parity after enough allocator churn).
+    ``MXTPU_DONATE=0/1`` forces either behavior for A/B studies."""
+    env = os.environ.get("MXTPU_DONATE")
+    if env is not None:
+        return (0, 1) if env.strip().lower() not in ("0", "false", "off") \
+            else ()
+    import jax
+
+    return () if jax.default_backend() == "cpu" else (0, 1)
+
+
 class _Program:
     """One compiled step program + the trace metadata needed to drive it."""
 
@@ -1739,7 +1761,8 @@ class CompiledTrainStep:
                  bs.padded * onp.dtype(dt).itemsize * gathers if sh else 0,
                  bs.padded * onp.dtype(dt).itemsize if sh else 0)
                 for layer, dt, _, bs, sh in groups)
-        return _Program(jax.jit(fn, donate_argnums=(0, 1)), uses_rng,
+        return _Program(jax.jit(fn, donate_argnums=train_donate_argnums()),
+                        uses_rng,
                         aux_targets, sharded=bucketed, fsdp=fsdp,
                         coll_bytes=coll_bytes,
                         k=k if multi else None, accum=g,
@@ -1811,8 +1834,19 @@ class CompiledTrainStep:
         ever sees sharded state; between steps the per-param arrays in
         ``trainer._states`` remain the source of truth, so inspection and
         checkpoints keep the classic layout at the cost of one state
-        reshard each way per step."""
+        reshard each way per step.
+
+        The buckets built here are DONATED (argnum 1), so they must never
+        alias the live state arrays: ``flatten`` of a single-tensor bucket
+        is a reshape (an alias), and once the states have been rebound to
+        slices of last dispatch's sharded output, ``device_put`` at the
+        already-matching sharding is a no-op on that alias — donating the
+        result would free the buffer the live states still point at (the
+        corruption only surfaces once the allocator reuses the memory).
+        ``jnp.array(..., copy=True)`` pins a fresh buffer in the chain."""
         import jax
+        import jax.numpy as jnp
+
         from .parallel.mesh import shard_1d
 
         tr = self.trainer
@@ -1820,7 +1854,9 @@ class CompiledTrainStep:
         sharding = shard_1d(self.mesh)
         return tuple(
             tuple(jax.device_put(
-                bs.flatten([tr._states[idxs[k]][key]._data for k in ks]),
+                jnp.array(bs.flatten(
+                    [tr._states[idxs[k]][key]._data for k in ks]),
+                    copy=True),
                 sharding) for key in self._state_keys)
             for _, ks, bs in self._buckets)
 

@@ -56,3 +56,6 @@ def pytest_configure(config):
     config.addinivalue_line("markers", "integration: end-to-end tests")
     config.addinivalue_line(
         "markers", "chaos: fault-injection tests (MXTPU_FAULT_* harness)")
+    config.addinivalue_line(
+        "markers",
+        "slow: nightly-scale sweeps excluded from the default (tier-1) run")

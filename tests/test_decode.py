@@ -1,7 +1,9 @@
-"""Continuous-batching decode engine (ISSUE 7): slot-paged KV cache,
-the two AOT program families, the scheduler's join/evict/shed behavior,
-greedy parity against naive generate, the zero-steady-state-compile
-contract, and the warmup-manifest / export round-trips."""
+"""Continuous-batching decode engine (ISSUE 7, v2 in ISSUE 18): paged KV
+cache + page allocator, radix prefix cache, speculative multi-token
+ticks, the three AOT program families, the scheduler's join/evict/shed
+behavior, greedy parity against naive generate, the
+zero-steady-state-compile contract, and the warmup-manifest / export
+round-trips."""
 import json
 import threading
 
@@ -12,8 +14,10 @@ import mxnet_tpu as mx
 from mxnet_tpu import serve, telemetry as tm
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.gluon.model_zoo import gpt_tiny
-from mxnet_tpu.serve.decode import (DecodeEngine, KVCache, ShedError,
-                                    SlotAllocator)
+from mxnet_tpu.serve.decode import (DecodeEngine, KVCache, PageAllocator,
+                                    PagedKVCache, RadixPrefixCache,
+                                    ShedError, SlotAllocator,
+                                    accept_longest_prefix, make_draft)
 
 VOCAB = 50
 MAX_LEN = 64
@@ -51,10 +55,13 @@ def net():
 @pytest.fixture(scope="module")
 def warm_engine(net):
     # one warmed engine shared by the read-only tests: warmup compiles
-    # O(log B · log T) prefills + one decode program, which dominates the
-    # file's runtime if paid per test
+    # O(log B · log T) prefills (x2 with the prefix-join family) + one
+    # decode program, which dominates the file's runtime if paid per
+    # test. All three v2 features on: every parity test below doubles as
+    # a bitwise-equivalence check for paging + prefix + speculation.
     eng = DecodeEngine(net, num_slots=4, max_len=MAX_LEN, max_prompt_len=16,
-                       prefill_batch=4, cache_dir=False)
+                       prefill_batch=4, page_tokens=8, speculate_k=4,
+                       prefix_cache=True, cache_dir=False)
     eng.warmup()
     yield eng
     eng.close()
@@ -99,6 +106,124 @@ def test_kv_cache_shape_and_rebind():
     assert cache.k is not k0
     with pytest.raises(MXNetError, match="cache shape"):
         KVCache((2, 3, 4))
+
+
+# -- page allocator / paged KV cache ----------------------------------------
+def test_page_allocator_alloc_free_reuse_exhaustion():
+    alloc = PageAllocator(4)
+    got = alloc.alloc(3)
+    assert len(got) == 3 and alloc.free_count == 1
+    assert alloc.alloc(2) is None          # all-or-nothing: no partial grant
+    assert alloc.free_count == 1           # the failed alloc took nothing
+    one = alloc.alloc(1)
+    assert alloc.alloc(1) is None and alloc.free_count == 0
+    alloc.free(one + got[:1])
+    assert alloc.free_count == 2 and len(alloc.live) == 2
+    again = alloc.alloc(2)
+    assert set(again) == set(one + got[:1])   # freed ids come back
+    with pytest.raises(MXNetError, match="double free"):
+        alloc.free(again[:1] + again[:1])
+    with pytest.raises(MXNetError, match="at least one page"):
+        PageAllocator(0)
+    assert alloc.alloc(0) == []
+
+
+def test_paged_kv_cache_tables_and_bytes():
+    cache = PagedKVCache((6, 2, 4, 8, 5), "float32", num_slots=3,
+                         max_len=16)
+    assert cache.page_tokens == 8 and cache.pages_per_slot == 2
+    assert cache.trash == 6
+    assert cache.table.shape == (3, 3)     # W + 1 sentinel column
+    assert (cache.table == 6).all()
+    assert cache.nbytes == 6 * 2 * 4 * 8 * 5 * 4 * 2
+    sid = cache.slots.alloc()
+    cache.table[sid, :2] = cache.pages.alloc(2)
+    cache.lengths[sid] = 9
+    assert cache.pages_live() == 2
+    cache.reset_row(sid)
+    assert (cache.table[sid] == 6).all() and cache.lengths[sid] == 0
+    with pytest.raises(MXNetError, match="pool shape"):
+        PagedKVCache((6, 2, 4), num_slots=3, max_len=16)
+
+
+# -- radix prefix cache ------------------------------------------------------
+def test_radix_insert_match_refcounts():
+    tree = RadixPrefixCache(page_tokens=4)
+    prompt = list(range(10, 21))            # 11 tokens = 2 full pages + 3
+    h1, adopted = tree.insert(prompt, {0: 100, 1: 101})
+    assert adopted == {0, 1}
+    # same prompt again: pages already covered, nothing adopted
+    h2, adopted2 = tree.insert(prompt, {0: 200, 1: 201})
+    assert adopted2 == set()
+    # shared-prefix lookup: full pages inside the shared span only
+    m, pages, hm = tree.match(prompt[:9] + [99, 98])
+    assert m == 8 and pages == [100, 101]
+    # a prompt that IS exactly the cached pages + nothing to prefill must
+    # hold one token back for the join program's last-logit select
+    m2, pages2, h3 = tree.match(prompt[:8])
+    assert m2 == 4 and pages2 == [100]
+    # pinned nodes are not evictable until every handle is released
+    assert tree.evictable_pages() == 0
+    assert tree.evict(2) == []
+    for h in (h1, h2, hm, h3):
+        tree.release(h)
+    assert tree.evictable_pages() == 2
+    freed = tree.evict(2)
+    assert set(freed) == {100, 101}
+    m3, pages3, _ = tree.match(prompt)
+    assert m3 == 0 and pages3 == []
+    with pytest.raises(MXNetError, match="full page"):
+        tree.insert([1, 2, 3], {0: 7})
+
+
+def test_radix_copy_on_write_divergence():
+    """Divergence inside a cached span never remaps the partially-shared
+    page: the match stops at the last fully-shared page boundary, so the
+    divergent request recomputes (copy-on-write by recompute) its own
+    copy into a private page."""
+    tree = RadixPrefixCache(page_tokens=4)
+    a = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    h, _ = tree.insert(a, {0: 50, 1: 51})
+    # diverges at token 6 (inside page 1): only page 0 is reusable
+    b = [1, 2, 3, 4, 5, 6, 99, 98, 97]
+    m, pages, hb = tree.match(b)
+    assert m == 4 and pages == [50]
+    # the divergent branch inserts its own page-1 copy; page 0 is shared
+    hb2, adopted = tree.insert(b, {0: 60, 1: 61})
+    assert adopted == {1}                  # page 0 already covered: kept
+    m2, pages2, hc = tree.match(b[:8] + [42])
+    assert m2 == 8 and pages2 == [50, 61]
+    # LRU eviction only touches refcount-0 leaves; pinned paths survive
+    for hx in (h, hb, hb2, hc):
+        tree.release(hx)
+    st = tree.stats()
+    assert st["pages"] == 3 and st["hits"] == 2
+    freed = tree.evict(10)                 # drain everything evictable
+    assert set(freed) == {50, 51, 61}
+
+
+# -- speculative accept rule -------------------------------------------------
+def test_accept_longest_prefix_edges():
+    # K=1 (no draft): always exactly the one verified token
+    assert accept_longest_prefix([], [7]) == 1
+    # full accept: every draft token matches the argmax chain
+    assert accept_longest_prefix([5, 6, 7], [5, 6, 7, 8]) == 4
+    # zero draft accepted: first draft token misses
+    assert accept_longest_prefix([9, 6, 7], [5, 6, 7, 8]) == 1
+    # partial: accept up to the first miss
+    assert accept_longest_prefix([5, 6, 9], [5, 6, 7, 8]) == 3
+
+
+def test_drafts():
+    ng = make_draft("ngram")
+    # trailing bigram (3, 4) occurred before, followed by 5
+    assert ng.propose([3, 4, 5, 9, 3, 4], 1) == [5]
+    # chained proposals extend the working context
+    assert ng.propose([1, 2, 3, 1, 2], 2) == [3, 1]
+    assert ng.propose([7], 3) == [7, 7, 7]  # no history: repeat last
+    assert make_draft("last").propose([1, 2, 3], 2) == [3, 3]
+    with pytest.raises(MXNetError, match="unknown draft"):
+        make_draft("bogus")
 
 
 # -- greedy parity: engine streams == naive generate ------------------------
@@ -231,10 +356,14 @@ def test_close_fails_outstanding_streams(net):
 
 # -- the zero-steady-state-compile contract ---------------------------------
 def test_zero_steady_state_compiles_64_ragged_clients(net):
-    """64 concurrent ragged-length clients against a warmed engine: the
-    recompile watchdog stays silent and the serve.* telemetry adds up."""
+    """64 concurrent ragged-length clients against a warmed engine with
+    ALL v2 features on (paged KV, radix prefix sharing, speculative K=4):
+    the recompile watchdog stays silent and the serve.* telemetry adds
+    up. Half the prompts share an 8-token prefix so the prefix-join
+    (prefill_ext) path runs under load too."""
     eng = DecodeEngine(net, num_slots=8, max_len=MAX_LEN, max_prompt_len=16,
-                       prefill_batch=4, max_queue=128, cache_dir=False)
+                       prefill_batch=4, page_tokens=8, speculate_k=4,
+                       prefix_cache=True, max_queue=128, cache_dir=False)
     try:
         tm.enable()
         eng.warmup()
@@ -242,6 +371,9 @@ def test_zero_steady_state_compiles_64_ragged_clients(net):
         c0 = tm.metrics()["jit.compiles"]
         r0 = tm.counter("jit.recompiles").value
         prompts = _prompts(64, lo=1, hi=16, seed=9)
+        shared = _prompts(1, lo=9, hi=10, seed=77)[0]   # covers one page
+        for i in range(0, 64, 2):
+            prompts[i] = shared + prompts[i][:7]
         budgets = [1 + (i % 6) for i in range(64)]
         results = {}
         barrier = threading.Barrier(8 + 1)
@@ -276,8 +408,87 @@ def test_zero_steady_state_compiles_64_ragged_clients(net):
         assert tm.histogram("serve.tpot_ms").percentiles(50)[0] is not None
         assert st["ttft_ms_p50"] is not None
         assert st["tpot_ms_p99"] >= st["tpot_ms_p50"]
+        # v2 surfaces: shared prefixes actually skipped prefill tokens,
+        # speculation actually verified drafts, pages stayed bounded
+        assert st["prefix_hit_tokens"] > 0
+        assert tm.counter("serve.prefix_hit_tokens").value == \
+            st["prefix_hit_tokens"]
+        assert tm.histogram("serve.spec_accept_len").count > 0
+        assert 1.0 <= st["spec_accept_mean"] <= 4.0
+        assert 0 <= st["kv_pages_live"] <= st["kv_pages"]
+        assert st["page_starved"] == 0     # full reservation: never starves
     finally:
         eng.close()
+
+
+# -- paged KV integration: prefix sharing, oversubscription, equal bytes ----
+def test_prefix_sharing_skips_prefill(net, warm_engine):
+    """A later request sharing a >= 1-page prompt prefix joins at the
+    page-aligned divergence offset: the shared span is counted as hit
+    tokens (its prefill is skipped) and the output stays bitwise equal
+    to naive greedy."""
+    eng = warm_engine
+    base = eng.stats()["prefix_hit_tokens"]
+    shared = [5, 9, 2, 8, 7, 3, 6, 4, 1]   # 9 tokens: one full 8-tok page
+    a = shared + [11, 12]
+    b = shared + [13, 14, 15]
+    got_a = eng.submit(a, max_new_tokens=5).result(timeout=120)
+    got_b = eng.submit(b, max_new_tokens=5).result(timeout=120)
+    assert got_a == _naive(net, a, 5)
+    assert got_b == _naive(net, b, 5)
+    # b (and possibly a repeat of the shared page) hit at least one page
+    assert eng.stats()["prefix_hit_tokens"] >= base + 8
+    pc = eng.stats()["prefix_cache"]
+    assert pc["hits"] >= 1 and pc["pages"] >= 1
+
+
+def test_page_pool_oversubscription_sheds_not_crashes(net):
+    """kv_pages below the full num_slots * W reservation: pages are
+    claimed on demand; a slot the pool cannot serve mid-flight truncates
+    (never crashes), and every survivor keeps bitwise greedy parity."""
+    eng = DecodeEngine(net, num_slots=4, max_len=MAX_LEN, max_prompt_len=16,
+                       prefill_batch=4, page_tokens=8, kv_pages=10,
+                       speculate_k=1, prefix_cache=False, cache_dir=False)
+    try:
+        eng.warmup()
+        prompts = _prompts(8, lo=4, hi=16, seed=13)
+        streams = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        for p, s in zip(prompts, streams):
+            got = s.result(timeout=300)
+            want = _naive(net, p, 12)
+            if s.truncated:
+                assert 1 <= len(got) and got == want[:len(got)]
+            else:
+                assert got == want
+        assert eng.healthy
+        st = eng.stats()
+        assert st["completed"] == 8 and st["kv_pages"] == 10
+        assert st["kv_pages_live"] == 0    # all pages back after retire
+    finally:
+        eng.close()
+
+
+def test_paged_pool_doubles_slots_at_equal_bytes(net):
+    """The paging acceptance gauge: doubling num_slots at a FIXED pool
+    leaves mem.kv_cache_bytes unchanged — resident KV bytes now scale
+    with the page pool, not with slots * max_len."""
+    tm.enable()
+    readings = {}
+    for slots in (4, 8):
+        eng = DecodeEngine(net, num_slots=slots, max_len=MAX_LEN,
+                           max_prompt_len=8, prefill_batch=1,
+                           page_tokens=8, kv_pages=16, prefix_cache=False,
+                           max_wait_us=0, cache_dir=False)
+        try:
+            eng.warmup()
+            eng.submit([1, 2, 3], max_new_tokens=2).result(timeout=120)
+            readings[slots] = int(tm.gauge("mem.kv_cache_bytes").value)
+            assert eng.stats()["cache_bytes"] == readings[slots]
+        finally:
+            eng.close()
+    assert readings[8] == readings[4]      # 2x slots, equal bytes
+    # pool-sized: [16 pages, 2 layers, 4 heads, 8 tok, 8 dim] f32 x k,v
+    assert readings[4] == 16 * 2 * 4 * 8 * 8 * 4 * 2
 
 
 # -- warmup manifest / export round trips -----------------------------------
@@ -296,10 +507,16 @@ def test_decode_manifest_roundtrip(net, tmp_path):
     m = serve.decode.load_decode_manifest(mpath)
     assert m["kind"] == "decode_engine" and m["num_slots"] == 4
     assert m["len_ladder"] == [8, 16] and m["batch_ladder"] == [1, 2]
+    # page_tokens clamps to max_len here, so the pool is one page per
+    # slot: same bytes as the old slot-cache reservation
+    assert m["page_tokens"] == MAX_LEN and m["kv_pages"] == 4
+    assert m["speculate_k"] == 1 and m["prefix_cache"] is True
     assert m["cache_shape"] == [4, 2, 4, MAX_LEN, 8]
     assert m["signatures"] == manifest["signatures"]
-    assert set(m["signatures"]) == {"decode", "prefill|1|8", "prefill|1|16",
-                                    "prefill|2|8", "prefill|2|16"}
+    assert set(m["signatures"]) == {
+        "decode|1", "prefill|1|8", "prefill|1|16", "prefill|2|8",
+        "prefill|2|16", "prefill_ext|1|8", "prefill_ext|1|16",
+        "prefill_ext|2|8", "prefill_ext|2|16"}
 
     # a fresh engine built FROM the manifest adopts its geometry, warms at
     # construction, and serves with zero further compiles
@@ -323,16 +540,24 @@ def test_decode_manifest_roundtrip(net, tmp_path):
 
 # -- bench smoke (mirrors test_bench_serve_smoke) ---------------------------
 def test_bench_serve_llm_smoke(monkeypatch):
-    """bench.py serve_llm (small): continuous batching beats the naive
-    per-request rolling-window loop and decodes with zero recompiles."""
+    """bench.py serve_llm (small) with the full v2 stack on — speculative
+    K=4, 50% prefix-shared prompts, paged 2x-slots at equal bytes: beats
+    the naive per-request rolling-window loop, decodes with zero
+    steady-state recompiles, and surfaces the v2 counters."""
     import bench
 
     monkeypatch.setenv("BENCH_SERVE_LLM_SMALL", "1")
+    monkeypatch.setenv("BENCH_SPECULATE_K", "4")
+    monkeypatch.setenv("BENCH_PREFIX_SHARED", "50")
+    monkeypatch.setenv("BENCH_PAGED", "1")
     r = bench.bench_serve_llm()
     assert r["unit"] == "tok/s" and r["value"] > 0
     assert r["compiles_steady"] == 0, r
     assert r["shed"] == 0 and r["evicted"] == 0
     assert r["ttft_ms_p99"] >= r["ttft_ms_p50"]
+    assert r["speculate_k"] == 4 and 1.0 <= r["spec_accept_mean"] <= 4.0
+    assert r["prefix_hit_tokens"] > 0
+    assert r["num_slots"] == 8 and r["paged_2x_slots"]
     # full-size runs show ~20-25x; 2x keeps the small CI box margin wide
     assert r["vs_baseline"] >= 2.0, r
 

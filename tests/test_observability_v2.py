@@ -460,3 +460,23 @@ def test_metric_docs_lint_catches_missing(tmp_path):
     doc.write_text("only `serve.dyn<N>.call` is documented here\n")
     missing = lint.missing_names(doc_path=doc, src_root=src)
     assert set(missing) == {"serve.not_documented_anywhere"}
+
+
+def test_env_var_docs_lint_catches_missing(tmp_path):
+    sys.path.insert(0, str(ROOT / "tools"))
+    try:
+        import check_metric_docs as lint
+    finally:
+        sys.path.pop(0)
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "m.py").write_text(
+        'x = os.environ.get("MXTPU_NOT_DOCUMENTED")\n'
+        'y = _env_int("MXTPU_DOCUMENTED_KNOB", 3)\n'
+        '_PREFIX = "MXTPU_FAM_"\n'
+        '# a docstring mention of MXTPU_ONLY_IN_PROSE is not a read\n')
+    doc = tmp_path / "ENV_VARS.md"
+    doc.write_text("`MXTPU_DOCUMENTED_KNOB` and the `MXTPU_FAM_<POINT>` "
+                   "family are documented here\n")
+    missing = lint.missing_env_vars(doc_path=doc, src_root=src)
+    assert set(missing) == {"MXTPU_NOT_DOCUMENTED"}

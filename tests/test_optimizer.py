@@ -62,7 +62,7 @@ def test_clip_gradient():
 @pytest.mark.parametrize("name", ALL_OPTS)
 def test_optimizer_minimizes_quadratic(name):
     kwargs = {"learning_rate": 0.05}
-    if name in ("adam", "adamw", "adamax", "nadam", "adabelief", "lamb",
+    if name in ("adam", "adamw", "adamax", "nadam", "adabelief",
                 "ftml", "lans"):
         kwargs["learning_rate"] = 0.1
     if name in ("adagrad", "ftrl"):
@@ -71,8 +71,21 @@ def test_optimizer_minimizes_quadratic(name):
         kwargs["learning_rate"] = 1.0
     if name == "lars":
         kwargs["learning_rate"] = 10.0  # trust ratio ~ eta*|w|/|g| is tiny
+    if name == "lamb":
+        # LAMB's trust ratio renormalizes every step to ~lr * |w|, so it
+        # oscillates around the optimum at that amplitude forever; lr=0.1
+        # leaves a ~0.36 floor that straddles the tolerance
+        kwargs["learning_rate"] = 0.02
+    gscale = 1.0
     if name == "sgld":
-        kwargs["learning_rate"] = 0.01
+        # SGLD SAMPLES the Gibbs posterior exp(-U), it does not minimize:
+        # with U = (w - t)^2 the stationary std is 1/sqrt(2) per
+        # coordinate, and ~50 correlated tail iterates average < 1
+        # effective sample — the old lr=0.01 run failed on noise alone.
+        # Sharpen the posterior instead (U = 100 (w - t)^2 => std 0.07)
+        # and keep lr inside the stability region of that curvature.
+        kwargs["learning_rate"] = 0.001
+        gscale = 100.0
         mx.random.seed(42)  # Langevin noise: pin the seed for determinism
     opt = optimizer.create(name, **kwargs)
     target = onp.array([1.0, -2.0, 3.0], "float32")
@@ -81,7 +94,7 @@ def test_optimizer_minimizes_quadratic(name):
     state = opt.create_state(0, w)
     tail = []
     for i in range(500):
-        g = NDArray(2 * (w.asnumpy() - target))
+        g = NDArray(gscale * 2 * (w.asnumpy() - target))
         opt.update(0, w, g, state)
         if i >= 450:
             tail.append(w.asnumpy().copy())

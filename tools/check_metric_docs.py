@@ -1,13 +1,20 @@
 #!/usr/bin/env python3
 """Lint: every ``serve.*`` / ``telemetry.*`` / ``checkpoint.*`` /
 ``fault.*`` / ``train.*`` metric name created anywhere in ``mxnet_tpu/``
-must appear in docs/DESIGN.md (the Observability metric inventory), so
-the exported namespace and the documentation cannot drift.
+must appear in docs/DESIGN.md (the Observability metric inventory), and
+every ``MXTPU_*`` environment variable actually read from the
+environment must appear in docs/ENV_VARS.md — so the exported
+namespaces and the documentation cannot drift.
 
-Literal names must appear verbatim; f-string names (dynamic buckets like
-``serve.bucket{bucket}.call``) are checked by their literal prefix up to
-the first ``{``. Exits non-zero listing the undocumented names. Run
-directly or via tests/test_observability_v2.py.
+Literal metric names must appear verbatim; f-string names (dynamic
+buckets like ``serve.bucket{bucket}.call``) are checked by their literal
+prefix up to the first ``{``. Env vars are collected only at READ sites
+(``os.environ.get/[]``, ``os.getenv``, the local ``_env_*`` helpers) so
+docstring mentions don't count as definitions; dynamic families read by
+prefix scan (``MXTPU_FAULT_*``) are covered by the prefix-constant
+assignment matching the documented family row. Exits non-zero listing
+the undocumented names. Run directly or via
+tests/test_observability_v2.py.
 """
 from __future__ import annotations
 
@@ -17,6 +24,7 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 DESIGN = ROOT / "docs" / "DESIGN.md"
+ENV_VARS = ROOT / "docs" / "ENV_VARS.md"
 
 # any Registry accessor or direct metric-class construction carrying a
 # name in a linted namespace, e.g. REGISTRY.counter("serve.requests") or
@@ -52,17 +60,68 @@ def missing_names(doc_path=DESIGN, src_root=None):
             if name not in doc}
 
 
+# an MXTPU_* name counts only where it is READ from the environment: the
+# stdlib accessors, the per-module _env_int/_env_str-style helpers, or a
+# *_PREFIX constant feeding a dynamic os.environ scan (chaos harness) —
+# a name quoted in a docstring or error message is not a definition
+_ENV_READ = re.compile(
+    r"(?:environ\.get\(|environ\[|getenv\(|_env_[a-z]+\(|_PREFIX\s*=\s*)"
+    r"\s*([\"'])(MXTPU_[A-Z0-9_]+)\1")
+
+
+def collect_env(src_root=None):
+    """{env_var_or_prefix: [file:line, ...]} over mxnet_tpu/**/*.py."""
+    src_root = pathlib.Path(src_root) if src_root else ROOT / "mxnet_tpu"
+    found = {}
+    for path in sorted(src_root.rglob("*.py")):
+        text = path.read_text()
+        for m in _ENV_READ.finditer(text):
+            name = m.group(2)
+            line = text.count("\n", 0, m.start()) + 1
+            try:
+                rel = path.relative_to(ROOT)
+            except ValueError:
+                rel = path
+            found.setdefault(name, []).append(f"{rel}:{line}")
+    return found
+
+
+def missing_env_vars(doc_path=ENV_VARS, src_root=None):
+    """Env vars read in the source but absent from docs/ENV_VARS.md.
+
+    A trailing-underscore name is a dynamic-family prefix; it is
+    documented if any documented name starts with it (e.g. the
+    ``MXTPU_FAULT_<POINT>`` row covers the ``MXTPU_FAULT_`` scan).
+    """
+    doc = pathlib.Path(doc_path).read_text()
+    return {name: sites for name, sites in collect_env(src_root).items()
+            if name not in doc}
+
+
 def main():
+    rc = 0
     missing = missing_names()
     if not missing:
         print(f"metric docs lint: all {len(collect())} "
               "serve./telemetry./checkpoint./fault./train./mem./numerics. "
               "names documented in docs/DESIGN.md")
-        return 0
-    print("metric names missing from docs/DESIGN.md:", file=sys.stderr)
-    for name, sites in sorted(missing.items()):
-        print(f"  {name}  (created at {', '.join(sites)})", file=sys.stderr)
-    return 1
+    else:
+        print("metric names missing from docs/DESIGN.md:", file=sys.stderr)
+        for name, sites in sorted(missing.items()):
+            print(f"  {name}  (created at {', '.join(sites)})",
+                  file=sys.stderr)
+        rc = 1
+    missing_env = missing_env_vars()
+    if not missing_env:
+        print(f"env var docs lint: all {len(collect_env())} MXTPU_* "
+              "variables read in mxnet_tpu/ documented in docs/ENV_VARS.md")
+    else:
+        print("MXTPU_* env vars missing from docs/ENV_VARS.md:",
+              file=sys.stderr)
+        for name, sites in sorted(missing_env.items()):
+            print(f"  {name}  (read at {', '.join(sites)})", file=sys.stderr)
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":
