@@ -473,6 +473,104 @@ def bench_train_step_fsdp():
             "mfu": None}
 
 
+def bench_train_step_tp():
+    """Megatron tensor parallelism composed with FSDP inside the compiled
+    step (``compile_step(shard_params=True)`` on a dp x tp mesh with 'tp'
+    partition rules): a GPT block trained under the mesh named by
+    ``--mesh dpNxtpM`` (BENCH_MESH, default dp4xtp2) against plain FSDP
+    with every device on dp. On a host mesh where collectives are memcpys
+    the win column is residency — each replica holds 1/(dp*tp) of the
+    megatron groups — and the per-axis collective_bytes.dp/.tp split shows
+    where the traffic goes. Reports steps/s both ways, the tp/dp-only
+    ratio, the per-replica vs replicated param bytes, per-axis collective
+    bytes per step, and the dispatch/recompile accounting. Select with
+    ``bench.py train_step --mesh dp4xtp2``. BENCH_TRAIN_STEP_SMALL=1
+    shrinks the model/iterations for the not-slow suite."""
+    import re as _re
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, initializer, telemetry
+    from mxnet_tpu.gluon.model_zoo.gpt import gpt_tiny, gpt_tp_rules
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    small = os.environ.get("BENCH_TRAIN_STEP_SMALL", "") == "1"
+    spec = os.environ.get("BENCH_MESH", "") or "dp4xtp2"
+    m = _re.fullmatch(r"dp(\d+)xtp(\d+)", spec)
+    if m is None:
+        raise RuntimeError(f"BENCH_MESH must look like dp4xtp2, got {spec!r}")
+    n_dp, n_tp = int(m.group(1)), int(m.group(2))
+    if small:
+        V, B, T, LAYERS, UNITS, WARMUP, ITERS = 67, 8, 12, 2, 64, 2, 8
+    else:
+        V, B, T, LAYERS, UNITS, WARMUP, ITERS = 384, 16, 32, 4, 128, 3, 20
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.randint(0, V, (B, T)).astype("int32"))
+    y = mx.np.array(rs.randint(0, V, (B, T)).astype("int32"))
+
+    def run(mesh_axes, rules):
+        mx.random.seed(7)
+        net = gpt_tiny(vocab_size=V, dropout=0.0, num_layers=LAYERS,
+                       units=UNITS, num_heads=4, max_length=max(T, 16))
+        net.initialize(initializer.Normal(0.05))
+        net(x)  # settle shapes
+        tr = gluon.Trainer(net.collect_params(), "adam",
+                           {"learning_rate": 1e-3})
+        step = tr.compile_step(net, loss_fn, mesh=make_mesh(mesh_axes),
+                               shard_params=True, partition_rules=rules)
+        for _ in range(WARMUP):
+            _sync(step(x, y)._data)
+        if step.fallback_reason is not None:
+            raise RuntimeError("compile_step fell back: "
+                               + step.fallback_reason)
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            loss = step(x, y)
+        _sync(loss._data)
+        sps = ITERS / (time.perf_counter() - t0)
+        g = {k: telemetry.gauge(f"train_step.{k}").value
+             for k in ("param_bytes_per_replica", "param_bytes_replicated")}
+        return step, sps, g
+
+    _, dp_sps, _ = run({"dp": n_dp * n_tp}, None)
+    step_t, tp_sps, tp_g = run({"dp": n_dp, "tp": n_tp},
+                               gpt_tp_rules("train"))
+    if not step_t.shard_params:
+        raise RuntimeError(step_t.shard_params_fallback_reason)
+
+    # accounting pass AFTER the timed loops: telemetry on, a few dp x tp
+    # steps, read the per-step dispatch and per-axis collective traffic
+    was_on = telemetry.is_enabled()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        d0 = telemetry.counter("collective_bytes.dp").value
+        t0 = telemetry.counter("collective_bytes.tp").value
+        for _ in range(3):
+            _sync(step_t(x, y)._data)
+        rows = telemetry.step_report()
+        dp_bytes = (telemetry.counter("collective_bytes.dp").value - d0) // 3
+        tp_bytes = (telemetry.counter("collective_bytes.tp").value - t0) // 3
+    finally:
+        telemetry.enable() if was_on else telemetry.disable()
+    disp = max(r["dispatches"] for r in rows) if rows else -1
+    recomp = sum(r["recompiles"] for r in rows) if rows else -1
+    return {"metric": "train_step_tp_gpt",
+            "value": round(tp_sps, 2), "unit": "steps/s",
+            "vs_baseline": round(tp_sps / max(dp_sps, 1e-9), 3),
+            "dp_only_steps_per_sec": round(dp_sps, 2),
+            "mesh": spec, "dp_size": n_dp, "tp_size": n_tp,
+            "param_bytes_per_replica": int(tp_g["param_bytes_per_replica"]),
+            "param_bytes_replicated": int(tp_g["param_bytes_replicated"]),
+            "collective_bytes_dp_per_step": int(dp_bytes),
+            "collective_bytes_tp_per_step": int(tp_bytes),
+            "dispatches_per_step": disp,
+            "recompiles_after_warmup": recomp,
+            "compiled_programs": step_t._traces,
+            "mfu": None}
+
+
 def bench_train_step_multi():
     """Scanned super-step execution (``compile_step(multi_step=K)``): K
     optimizer steps per dispatch via ``lax.scan``, fed by a
@@ -994,7 +1092,9 @@ def bench_serve_llm():
     a shared multi-page prompt prefix so the radix cache skips its
     re-prefill; ``--paged`` (BENCH_PAGED=1) doubles num_slots while
     pinning the page pool to the UN-doubled reservation — 2x concurrency
-    at equal KV bytes."""
+    at equal KV bytes; ``--tp N`` (BENCH_SERVE_TP) serves the model
+    tensor-parallel over a {'tp': N} mesh — column-sharded weights,
+    head-sharded KV pools, greedy output still bitwise vs naive."""
     import threading
 
     import mxnet_tpu as mx
@@ -1016,6 +1116,7 @@ def bench_serve_llm():
     MAX_NEW = int(os.environ.get("BENCH_MAX_NEW", "") or MAX_NEW)
     MAX_NEW = min(MAX_NEW, MAX_LEN - MAX_PROMPT)
     VOCAB = 256
+    tp = int(os.environ.get("BENCH_SERVE_TP", "1") or 1)
     speculate = int(os.environ.get("BENCH_SPECULATE_K", "0") or 0)
     prefix_pct = max(0, min(100, int(
         os.environ.get("BENCH_PREFIX_SHARED", "0") or 0)))
@@ -1086,6 +1187,8 @@ def bench_serve_llm():
         if v2:
             kw.update(page_tokens=PAGE, speculate_k=max(1, speculate),
                       prefix_cache=True)
+        if tp > 1:
+            kw["tp"] = tp
         if paged2x:
             # equal-bytes contract: the pool stays at the UN-doubled
             # slot reservation while num_slots doubles
@@ -1140,6 +1243,7 @@ def bench_serve_llm():
             "speculate_k": st["speculate_k"],
             "spec_accept_mean": (round(st["spec_accept_mean"], 3)
                                  if "spec_accept_mean" in st else None),
+            "tp": tp,
             "prefix_shared_pct": prefix_pct,
             "prefix_hit_tokens": st["prefix_hit_tokens"],
             "prompt_tokens": sum(len(p) for p in prompts),
@@ -1292,8 +1396,17 @@ def main():
         i = sys.argv.index("--multi-step")
         if len(sys.argv) > i + 1 and sys.argv[i + 1].isdigit():
             os.environ["BENCH_MULTI_STEP"] = sys.argv[i + 1]
+    if which == "train_step" and "--mesh" in sys.argv[2:]:
+        which = "train_step_tp"
+        i = sys.argv.index("--mesh")
+        if len(sys.argv) > i + 1:
+            os.environ["BENCH_MESH"] = sys.argv[i + 1]
     if which == "serve_llm":
         argv = sys.argv[2:]
+        if "--tp" in argv:
+            i = sys.argv.index("--tp")
+            if len(sys.argv) > i + 1 and sys.argv[i + 1].isdigit():
+                os.environ["BENCH_SERVE_TP"] = sys.argv[i + 1]
         if "--speculate" in argv:
             i = sys.argv.index("--speculate")
             if len(sys.argv) > i + 1 and sys.argv[i + 1].isdigit():
@@ -1314,6 +1427,7 @@ def main():
               "train_step": bench_train_step,
               "train_step_sharded": bench_train_step_sharded,
               "train_step_fsdp": bench_train_step_fsdp,
+              "train_step_tp": bench_train_step_tp,
               "train_step_multi": bench_train_step_multi,
               "lstm_lm": bench_lstm_lm,
               "bert_pretrain": bench_bert_pretrain,

@@ -28,7 +28,45 @@ from ...base import MXNetError
 from .. import nn
 from ..block import HybridBlock
 
-__all__ = ["GPTModel", "gpt2_small", "gpt2_medium", "gpt_tiny"]
+__all__ = ["GPTModel", "gpt2_small", "gpt2_medium", "gpt_tiny",
+           "gpt_tp_rules"]
+
+
+def _local_heads(num_heads):
+    """Per-rank head count under an active tensor-parallel context (the
+    identity without one — single-device graphs are untouched)."""
+    from ...parallel import tp as _tp
+
+    ctx = _tp.current()
+    return ctx.local_heads(num_heads) if ctx is not None else num_heads
+
+
+def gpt_tp_rules(mode="train", fsdp_axis="dp"):
+    """Ordered partition rules declaring GPTModel's megatron layout.
+
+    ``mode="train"``: column-parallel ``attn_qkv``/``ffn_1`` (weights AND
+    biases; the fused QKV carries ``segments=3`` so each of Q/K/V splits
+    per rank), ROW-parallel ``attn_proj``/``ffn_2`` weights, everything
+    else dp-sharded (FSDP) via the catch-all.
+
+    ``mode="serve"``: column-parallel only — merged activations are
+    BITWISE the unsharded model's — with every other leaf replicated.
+    """
+    from jax.sharding import PartitionSpec as PS
+
+    col = [
+        (r"attn_qkv\.weight$", PS("tp", None), {"segments": 3}),
+        (r"attn_qkv\.bias$", PS("tp"), {"segments": 3}),
+        (r"ffn_1\.weight$", PS("tp", None)),
+        (r"ffn_1\.bias$", PS("tp")),
+    ]
+    if mode == "serve":
+        return tuple(col) + ((r".*", PS()),)
+    row = [
+        (r"attn_proj\.weight$", PS(None, "tp")),
+        (r"ffn_2\.weight$", PS(None, "tp")),
+    ]
+    return tuple(col + row) + ((r".*", PS(fsdp_axis)),)
 
 
 class DecoderLayer(HybridBlock):
@@ -58,8 +96,18 @@ class DecoderLayer(HybridBlock):
                               in_units=hidden_size)
 
     def _qkv(self, x):
+        from ...parallel import tp as _tp
+
         h = self.ln_1(x)
+        ctx = _tp.current()
+        if ctx is not None and ctx.mode == "train":
+            # megatron f at the attention region's entry: upstream (the
+            # residual stream, norms, embeddings) receives the complete
+            # tp-summed gradient
+            h = _tp.tp_copy(h)
         qkv = self.attn_qkv(h)
+        # under tp the local qkv is [Q_r | K_r | V_r] (segments=3 layout),
+        # so thirds of the LOCAL width still split q/k/v correctly
         units = qkv.shape[-1] // 3
         q = npx.slice_axis(qkv, axis=-1, begin=0, end=units)
         k = npx.slice_axis(qkv, axis=-1, begin=units, end=2 * units)
@@ -67,12 +115,37 @@ class DecoderLayer(HybridBlock):
         return q, k, v
 
     def _post_attention(self, x, attn):
-        attn = self.attn_proj(attn)
+        from ... import numpy as np
+        from ...parallel import tp as _tp
+
+        ctx = _tp.current()
+        if ctx is None:
+            attn = self.attn_proj(attn)
+        elif ctx.mode == "train":
+            # row-parallel attn_proj: the local W columns against the local
+            # attn slice yield a partial sum; megatron g completes it. The
+            # bias adds AFTER the psum so it counts once, not tp times
+            attn = _tp.tp_sum(np.matmul(
+                attn, self.attn_proj.weight.data().T)) \
+                + self.attn_proj.bias.data()
+        else:
+            # serving: column-split heads merge by concatenation (bitwise
+            # the unsharded activations), then the replicated projection
+            attn = self.attn_proj(_tp.tp_gather(attn, dim=-1))
         if self._dropout:
             attn = npx.dropout(attn, p=self._dropout)
         x = x + attn
         h = self.ln_2(x)
-        ffn = self.ffn_2(npx.leaky_relu(self.ffn_1(h), act_type="gelu"))
+        if ctx is not None and ctx.mode == "train":
+            h = _tp.tp_copy(h)   # megatron f at the MLP region's entry
+        up = npx.leaky_relu(self.ffn_1(h), act_type="gelu")
+        if ctx is None:
+            ffn = self.ffn_2(up)
+        elif ctx.mode == "train":
+            ffn = _tp.tp_sum(np.matmul(
+                up, self.ffn_2.weight.data().T)) + self.ffn_2.bias.data()
+        else:
+            ffn = self.ffn_2(_tp.tp_gather(up, dim=-1))
         if self._dropout:
             ffn = npx.dropout(ffn, p=self._dropout)
         return x + ffn
@@ -90,7 +163,8 @@ class DecoderLayer(HybridBlock):
         ``forward`` — prefill and the plain forward cannot drift."""
         q, k, v = self._qkv(x)
         attn = npx.multihead_attention(q, k, v, mask=mask,
-                                       num_heads=self._num_heads,
+                                       num_heads=_local_heads(
+                                           self._num_heads),
                                        causal=True)
         return self._post_attention(x, attn), k, v
 
@@ -113,7 +187,8 @@ class DecoderLayer(HybridBlock):
         k_cache = np.where(write_mask, k, k_cache)
         v_cache = np.where(write_mask, v, v_cache)
         attn = npx.multihead_attention(q, k_cache, v_cache, mask=kv_mask,
-                                       num_heads=self._num_heads,
+                                       num_heads=_local_heads(
+                                           self._num_heads),
                                        causal=False)
         return self._post_attention(x, attn), k_cache, v_cache
 
@@ -147,6 +222,12 @@ class GPTModel(HybridBlock):
                                     in_units=units)
 
     # -- shared pieces ------------------------------------------------------
+    def tp_partition_rules(self, mode="serve"):
+        """The megatron layout of this architecture (see
+        :func:`gpt_tp_rules`) — the hook ``serve.decode`` consults when a
+        tensor-parallel engine is requested."""
+        return gpt_tp_rules(mode)
+
     def _lm_logits(self, x):
         from ... import numpy as np
 
@@ -166,21 +247,24 @@ class GPTModel(HybridBlock):
         return (ar < valid).reshape(-1, 1, 1, seq_len)
 
     def _split_heads(self, x):
-        """(B, T, units) -> (B, heads, T, head_dim) — the KV-cache layout."""
+        """(B, T, units) -> (B, heads, T, head_dim) — the KV-cache layout.
+        Head count derives from the ACTUAL width so tensor-parallel local
+        slices (units/tp, heads/tp, same head_dim) split correctly."""
         from ... import numpy as np
 
         T = x.shape[1]
         d = self._units // self._num_heads
         return np.transpose(
-            np.reshape(x, (-1, T, self._num_heads, d)), (0, 2, 1, 3))
+            np.reshape(x, (-1, T, x.shape[-1] // d, d)), (0, 2, 1, 3))
 
     def _merge_heads(self, x):
-        """(B, heads, T, head_dim) -> (B, T, units)."""
+        """(B, heads, T, head_dim) -> (B, T, units) — shape-derived, so a
+        tensor-parallel local (heads/tp) stack merges to units/tp."""
         from ... import numpy as np
 
         T = x.shape[2]
         return np.reshape(np.transpose(x, (0, 2, 1, 3)),
-                          (-1, T, self._units))
+                          (-1, T, x.shape[1] * x.shape[3]))
 
     def _embed(self, tokens, pos):
         x = self.tok_embed(tokens) + self.pos_embed(pos)
@@ -217,7 +301,8 @@ class GPTModel(HybridBlock):
                 f"cache max_len {max_len} exceeds the position table "
                 f"max_length={self.max_length}")
         d = self._units // self._num_heads
-        shape = (batch, self._num_layers, self._num_heads, max_len, d)
+        shape = (batch, self._num_layers, _local_heads(self._num_heads),
+                 max_len, d)
         return (np.zeros(shape, dtype=self._dtype),
                 np.zeros(shape, dtype=self._dtype))
 
@@ -303,8 +388,8 @@ class GPTModel(HybridBlock):
         from ... import numpy as np
 
         d = self._units // self._num_heads
-        shape = (int(num_pages), self._num_layers, self._num_heads,
-                 int(page_tokens), d)
+        shape = (int(num_pages), self._num_layers,
+                 _local_heads(self._num_heads), int(page_tokens), d)
         return (np.zeros(shape, dtype=self._dtype),
                 np.zeros(shape, dtype=self._dtype))
 
@@ -420,7 +505,8 @@ class GPTModel(HybridBlock):
             viewv = np.where(wrote, np.einsum("btl,btu->blu", pos_oh, v),
                              viewv)
             attn = npx.multihead_attention(q, viewk, viewv, mask=mask,
-                                           num_heads=self._num_heads,
+                                           num_heads=_local_heads(
+                                               self._num_heads),
                                            causal=False)
             x = blk._post_attention(x, attn)
         x = self.ln_f(x)
@@ -483,7 +569,8 @@ class GPTModel(HybridBlock):
             viewv = np.where(wrote, np.einsum("skl,sku->slu", pos_oh, v),
                              viewv)
             attn = npx.multihead_attention(q, viewk, viewv, mask=mask,
-                                           num_heads=self._num_heads,
+                                           num_heads=_local_heads(
+                                               self._num_heads),
                                            causal=False)
             x = blk._post_attention(x, attn)
         x = self.ln_f(x)

@@ -1,0 +1,135 @@
+"""Registered tensor-parallel collectives (the megatron f/g pair plus
+the serving gather) with ALGEBRAIC — not autodiff-default — transposes.
+
+The step body's cotangent convention is replicated-downstream: every tp
+rank carries the FULL upstream gradient (the loss and everything after
+the parallel region are replicated over 'tp'). Under that convention
+the AD transpose of a raw ``lax.psum`` is another psum — inflating the
+shard gradients by tp — and the transpose of a tiled ``all_gather`` is
+``psum_scatter`` (same inflation). ``jax.custom_vjp`` pins the correct
+pairings:
+
+- ``tp_copy``  (megatron *f*): forward identity, backward psum — the
+  entry of each parallel region, so replicated/dp-sharded upstream
+  parameters see the complete, tp-invariant gradient.
+- ``tp_sum``   (megatron *g*): forward psum, backward identity — the
+  exit of row-parallel layers in training.
+- ``tp_gather``: forward tiled all_gather, backward slice-own-chunk —
+  the exit of column-parallel layers into replicated math (the serving
+  path; a concatenation, so merged values are BITWISE the unsharded
+  model's).
+
+Registered ``jit=False`` so each replay re-evaluates the fn in its own
+context: inside ``shard_map`` the axis name is bound and the real
+collective lowers; in a plain eager evaluation (the deferred-compute
+trace, run with per-rank local values) the eager ``NameError: unbound
+axis name`` path substitutes a shape-correct stand-in and records the
+payload bytes on the active ``parallel.tp`` context — the build's only
+window into the in-program tp traffic (``collective_bytes.tp``).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _note(kind, nbytes):
+    from ..parallel import tp as _tp
+
+    ctx = _tp.current()
+    if ctx is not None:
+        if kind == "psum":
+            ctx.psum_bytes += int(nbytes)
+        else:
+            ctx.gather_bytes += int(nbytes)
+
+
+@functools.lru_cache(maxsize=None)
+def _copy_prim(axis):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, g: (lax.psum(g, axis),))
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _sum_prim(axis):
+    @jax.custom_vjp
+    def f(x):
+        return lax.psum(x, axis)
+
+    f.defvjp(lambda x: (lax.psum(x, axis), None), lambda _, g: (g,))
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _gather_prim(axis, dim, size):
+    @jax.custom_vjp
+    def f(x):
+        return lax.all_gather(x, axis, axis=dim, tiled=True)
+
+    def fwd(x):
+        return lax.all_gather(x, axis, axis=dim, tiled=True), None
+
+    def bwd(_, g):
+        local = g.shape[dim] // size
+        start = lax.axis_index(axis) * local
+        return (lax.dynamic_slice_in_dim(g, start, local, axis=dim),)
+
+    f.defvjp(fwd, bwd)
+    return f
+
+
+@register("tp_copy", jit=False)
+def _make_tp_copy(axis="tp"):
+    prim = _copy_prim(axis)
+
+    def f(x):
+        if isinstance(x, jax.core.Tracer):
+            try:
+                return prim(x)
+            except NameError:   # abstract eval outside shard_map
+                return x
+        # concrete (the eager trace): identity value, but account the
+        # bytes this op's BACKWARD psum moves in the compiled program
+        _note("psum", x.nbytes)
+        return x
+
+    return f
+
+
+@register("tp_sum", jit=False)
+def _make_tp_sum(axis="tp"):
+    prim = _sum_prim(axis)
+
+    def f(x):
+        if isinstance(x, jax.core.Tracer):
+            try:
+                return prim(x)
+            except NameError:
+                return x
+        _note("psum", x.nbytes)
+        return x   # rank-local partial: eager trace values are throwaway
+
+    return f
+
+
+@register("tp_gather", jit=False)
+def _make_tp_gather(axis="tp", size=2, dim=0):
+    prim = _gather_prim(axis, dim, size)
+
+    def f(x):
+        if isinstance(x, jax.core.Tracer):
+            try:
+                return prim(x)
+            except NameError:
+                return jnp.concatenate([x] * size, axis=dim)
+        _note("gather", x.nbytes * size)
+        return jnp.concatenate([x] * size, axis=dim)
+
+    return f

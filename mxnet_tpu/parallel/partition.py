@@ -8,19 +8,28 @@ partitioned; a leaf no rule covers is an explicit error naming the offending
 path — silent replication of a 30k x 4k embedding is exactly the bug this
 API exists to prevent.
 
-Two consumers share the vocabulary:
+A rule may be a 3-tuple ``(regex, PartitionSpec, meta)`` carrying layout
+metadata the PartitionSpec itself cannot: ``meta={"segments": S}`` marks a
+weight as S stacked logical blocks along its sharded dimension (the fused
+QKV projection: S=3), so tensor-parallel slicing splits each block
+per-rank instead of splitting the stack.
+
+Consumers sharing the vocabulary:
 
 - ``parallel.five_axis`` layouts (tp/pp/ep specs over stage-stacked trees)
   can be written as rules and expanded with ``match_partition_rules`` —
   rules mixing 'dp' with 'tp'/'pp' compose on one mesh because a
   PartitionSpec is just named mesh axes.
 - ``Trainer.compile_step(shard_params=True)`` (FSDP): the rules decide
-  which trainables live dp-sharded. ``fsdp_groups`` then folds the sharded
-  leaves into per-layer flat buckets (``collectives.BucketSpec``) — the
-  gather/scatter schedule of the compiled step.
+  which trainables live dp-sharded. On a dp x tp mesh, rules naming 'tp'
+  declare megatron column/row splits executed INSIDE the same compiled
+  step. ``fsdp_groups`` folds both kinds into per-layer flat buckets
+  (``collectives.BucketSpec``) — the gather/scatter schedule of the
+  compiled step.
 """
 from __future__ import annotations
 
+import collections
 import re
 
 from jax.sharding import PartitionSpec as PS
@@ -28,7 +37,14 @@ from jax.sharding import PartitionSpec as PS
 from ..base import MXNetError
 
 __all__ = ["named_tree_map", "match_partition_rules", "spec_axes",
-           "fsdp_rules", "layer_key", "fsdp_groups"]
+           "fsdp_rules", "layer_key", "fsdp_groups", "RuleMatch"]
+
+
+#: One matched rule: the PartitionSpec, the rule's metadata dict, and the
+#: regex pattern that matched (None for the scalar exemption / direct
+#: specs) — kept so downstream errors can name the offending RULE, not
+#: just the leaf path.
+RuleMatch = collections.namedtuple("RuleMatch", ["spec", "meta", "pattern"])
 
 
 def named_tree_map(fn, tree, sep="/"):
@@ -64,9 +80,28 @@ def _leaf_shape(path, leaf):
     return tuple(int(d) for d in shape)
 
 
-def match_partition_rules(rules, tree, sep="/"):
+def _expand_rules(rules):
+    """Normalize 2-/3-tuple rules to ``(regex, spec, meta)``."""
+    out = []
+    for rule in rules:
+        if len(rule) == 2:
+            pattern, spec = rule
+            meta = {}
+        elif len(rule) == 3:
+            pattern, spec, meta = rule
+        else:
+            raise MXNetError(
+                "partition rules are (regex, PartitionSpec) or (regex, "
+                f"PartitionSpec, meta) tuples; got {rule!r}")
+        out.append((pattern, spec, dict(meta or {})))
+    return out
+
+
+def match_partition_rules(rules, tree, sep="/", with_meta=False):
     """Expand ``rules`` — an ordered iterable of ``(regex, PartitionSpec)``
-    — over ``tree``, returning a same-structure tree of PartitionSpecs.
+    or ``(regex, PartitionSpec, meta)`` — over ``tree``, returning a
+    same-structure tree of PartitionSpecs (or :class:`RuleMatch` triples
+    with ``with_meta=True``).
 
     Contract (the SNIPPETS [3] semantics, hardened):
     - scalar and size-1 leaves get ``PS()`` without consulting the rules
@@ -75,7 +110,7 @@ def match_partition_rules(rules, tree, sep="/"):
       wins — order your specific rules before the catch-all;
     - a leaf no rule matches raises ``MXNetError`` naming the path.
     """
-    rules = [(r, spec) for r, spec in rules]
+    rules = _expand_rules(rules)
 
     def get(path, leaf):
         shape = _leaf_shape(path, leaf)
@@ -83,10 +118,10 @@ def match_partition_rules(rules, tree, sep="/"):
         for d in shape:
             size *= d
         if not shape or size == 1:
-            return PS()
-        for rule, spec in rules:
-            if re.search(rule, path) is not None:
-                return spec
+            return RuleMatch(PS(), {}, None) if with_meta else PS()
+        for pattern, spec, meta in rules:
+            if re.search(pattern, path) is not None:
+                return RuleMatch(spec, meta, pattern) if with_meta else spec
         raise MXNetError(
             f"no partition rule matched parameter {path!r} "
             f"(shape {shape}); add a rule or a catch-all ('.*', PS(...))")
@@ -122,36 +157,73 @@ def layer_key(name, sep="."):
     return name.rsplit(sep, 1)[0] if sep in name else name
 
 
-def fsdp_groups(entries, specs, n_shards, axis="dp", sep="."):
+def _spec_of(value):
+    """``(spec, meta, pattern)`` from a plain PartitionSpec or RuleMatch."""
+    if isinstance(value, RuleMatch):
+        return value.spec, value.meta, value.pattern
+    return value, {}, None
+
+
+def fsdp_groups(entries, specs, n_shards, axis="dp", sep=".",
+                tp_axis="tp", tp_size=1):
     """Fold flat named trainables into the per-layer bucket schedule.
 
     ``entries``: ordered ``(key, name, shape, dtype_str)`` tuples (key is
-    the caller's position index); ``specs``: ``{name: PartitionSpec}`` from
-    ``match_partition_rules``. Leaves whose spec mentions ``axis`` group
-    into one ``BucketSpec`` per (layer, dtype) sharded 1/N over ``axis``;
-    the rest (scalars, size-1, explicitly replicated leaves) pool into
-    per-dtype replicated buckets updated identically on every shard. A
-    spec mentioning any OTHER mesh axis is rejected — tensor-parallel
-    layouts compose at the five_axis/Learner level, not inside the
-    dp-compiled step.
+    the caller's position index); ``specs``: ``{name: PartitionSpec}`` (or
+    ``{name: RuleMatch}`` from ``match_partition_rules(with_meta=True)``).
+
+    Leaves whose spec mentions ``axis`` group into one ``BucketSpec`` per
+    (layer, dtype) sharded 1/N over ``axis``; the rest (scalars, size-1,
+    explicitly replicated leaves) pool into per-dtype replicated buckets
+    updated identically on every shard. On a dp x tp mesh (``tp_size >=
+    2``) a spec naming ``tp_axis`` declares a megatron split: the group's
+    BucketSpec is built over the per-rank LOCAL shapes (each tp rank owns
+    a disjoint 1/tp of the tensor, itself dp-sharded 1/N) and ``sharded``
+    is the string ``"tp"``. Any other axis is rejected with an error
+    naming the offending RULE pattern — a misconfigured rule list must be
+    debuggable from the message alone.
 
     Returns ``[(layer, dtype, keys, BucketSpec, sharded)]`` in
-    first-appearance order (the schedule order of the compiled program).
+    first-appearance order (the schedule order of the compiled program),
+    ``sharded in (False, True, "tp")``.
     """
+    from . import tp as _tp
     from .collectives import BucketSpec
 
+    supported = {axis} | ({tp_axis} if tp_size > 1 else set())
     grouped = {}   # (layer, dtype, sharded) -> [(key, shape)]
     order = []
     for key, name, shape, dtype in entries:
-        spec = specs[name]
+        spec, meta, pattern = _spec_of(specs[name])
         axes = spec_axes(spec)
-        if axes - {axis}:
+        extra = axes - supported
+        if extra:
+            rule = (f"rule {pattern!r}" if pattern is not None
+                    else f"spec {spec}")
+            if "pp" in extra:
+                hint = ("pipeline-stage layouts are scheduled by "
+                        "parallel.pipeline (schedule_1f1b), not sharded "
+                        "inside the dp x tp step")
+            elif tp_axis in extra:
+                hint = (f"'{tp_axis}' rules need a mesh carrying a "
+                        f"'{tp_axis}' axis of size >= 2 — compose one "
+                        "with make_mesh({'dp': ..., 'tp': ...})")
+            else:
+                hint = ("other axis layouts belong to parallel.five_axis "
+                        "/ parallel.learner")
             raise MXNetError(
-                f"partition rule for {name!r} names mesh axes "
-                f"{sorted(axes - {axis})}; compile_step shards parameters "
-                f"over '{axis}' only — tensor/pipeline-parallel specs "
-                "belong to parallel.five_axis / parallel.learner")
-        sharded = axis in axes
+                f"partition {rule} matched {name!r} but names mesh axes "
+                f"{sorted(extra)} unsupported inside compile_step; {hint}")
+        if tp_axis in axes:
+            dim = _tp.tp_dim(spec, axis=tp_axis)
+            segments = int(meta.get("segments", 1))
+            what = (f"{name!r} (rule {pattern!r})" if pattern is not None
+                    else f"{name!r}")
+            _tp._check_divisible(shape, dim, tp_size, segments, what=what)
+            shape = _tp.local_shape(shape, dim, tp_size, segments)
+            sharded = "tp"
+        else:
+            sharded = axis in axes
         gk = (layer_key(name, sep=sep) if sharded else "_replicated",
               dtype, sharded)
         if gk not in grouped:

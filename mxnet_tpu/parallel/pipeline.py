@@ -20,7 +20,57 @@ from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 
-__all__ = ["pipeline_apply", "pipeline_sharded"]
+__all__ = ["pipeline_apply", "pipeline_sharded", "schedule_1f1b",
+           "layer_ranges"]
+
+
+def layer_ranges(num_layers, num_stages):
+    """Contiguous layer-range stage assignment: ``[(lo, hi), ...]`` per
+    stage (hi exclusive), remainder layers to the EARLIER stages so the
+    last stage — which also carries the LM head — stays lightest. This is
+    the assignment a 'pp' partition rule's stage index refers to."""
+    num_layers, num_stages = int(num_layers), int(num_stages)
+    if num_stages < 1 or num_layers < num_stages:
+        raise MXNetError(
+            f"cannot split {num_layers} layers over {num_stages} pipeline "
+            "stages (need at least one layer per stage)")
+    base, extra = divmod(num_layers, num_stages)
+    out, lo = [], 0
+    for s in range(num_stages):
+        hi = lo + base + (1 if s < extra else 0)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
+def schedule_1f1b(num_stages, num_microbatches):
+    """The 1F1B (one-forward-one-backward) per-stage action schedule.
+
+    Returns a list over stages; stage s's entry is the ordered tuple of
+    ``("F", i)`` / ``("B", i)`` actions it executes over microbatches
+    ``i < num_microbatches``: ``min(S - s - 1, M)`` warmup forwards, then
+    a steady state alternating one forward with one backward, then the
+    cooldown backwards. Unlike GPipe (all M forwards before any
+    backward), a stage holds at most ``S - s`` activation stashes — the
+    schedule the scanned ``accumulate=G`` microbatch axis interleaves
+    when training rides pipeline stages.
+    """
+    S, M = int(num_stages), int(num_microbatches)
+    if S < 1 or M < 1:
+        raise MXNetError(
+            f"schedule_1f1b needs num_stages >= 1 and num_microbatches >= "
+            f"1, got {num_stages} x {num_microbatches}")
+    out = []
+    for s in range(S):
+        warmup = min(S - s - 1, M)
+        acts = [("F", i) for i in range(warmup)]
+        for i in range(M - warmup):
+            acts.append(("F", warmup + i))
+            acts.append(("B", i))
+        for i in range(M - warmup, M):
+            acts.append(("B", i))
+        out.append(tuple(acts))
+    return out
 
 
 def pipeline_apply(stage_fn, stage_params, microbatches, axis_name="pp"):

@@ -1,0 +1,235 @@
+"""Tensor-parallel (megatron) support: the trace-time context and the
+segmented host layouts.
+
+Training and serving both trace the model graph ONCE with eager values
+and replay it inside ``shard_map`` (the deferred-compute contract). A
+tensor-parallel model is therefore traced with each parameter's LOCAL
+shard bound to its variable — the traced shapes are the per-rank shapes
+— while a thread-local :class:`TPContext` tells the model blocks to
+emit the matching in-graph collectives (``ops.tp_collectives``) and to
+size head counts locally. Outside an active context every hook here is
+an identity, so the single-device model is structurally untouched.
+
+Host layouts: a rule may declare ``meta={"segments": S}`` for weights
+that are S stacked logical blocks along the sharded dimension (the
+fused QKV projection: S=3). Rank r's local image then takes the r-th
+1/tp slice of EACH block, so per-rank math stays the plain megatron
+column split. :func:`local_slice` / :func:`merge_local` /
+:func:`global_image` / :func:`from_global_image` are pure index
+permutations — checkpoint round-trips through them are bitwise.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from ..base import MXNetError
+
+TP_AXIS = "tp"
+
+__all__ = ["TPContext", "current", "activate", "tp_copy", "tp_sum",
+           "tp_gather", "tp_dim", "local_shape", "local_slice",
+           "merge_local", "global_image", "from_global_image"]
+
+
+class TPContext:
+    """Active while a tensor-parallel model graph is traced (or run).
+
+    ``mode`` picks the collective placement the blocks emit:
+
+    - ``"train"``: megatron f/g — ``tp_copy`` at each parallel region's
+      entry, row-parallel second layers exiting through ``tp_sum``.
+    - ``"serve"``: column-parallel only, merged by ``tp_gather`` (a
+      concatenation — no cross-rank arithmetic), so the served values
+      are BITWISE those of the unsharded model.
+
+    The byte accumulators are filled by the eager fallbacks of the
+    registered collectives during the trace — the build's only window
+    into the in-program tp traffic (``collective_bytes.tp``).
+    """
+
+    __slots__ = ("size", "axis", "mode", "rank", "psum_bytes",
+                 "gather_bytes")
+
+    def __init__(self, size, mode="train", axis=TP_AXIS, rank=0):
+        size = int(size)
+        if size < 2:
+            raise MXNetError(f"TPContext needs size >= 2, got {size}")
+        if mode not in ("train", "serve"):
+            raise MXNetError(f"TPContext mode must be 'train' or 'serve', "
+                             f"got {mode!r}")
+        self.size = size
+        self.axis = axis
+        self.mode = mode
+        self.rank = int(rank)   # whose local values the eager trace carries
+        self.psum_bytes = 0
+        self.gather_bytes = 0
+
+    def local_heads(self, num_heads):
+        if num_heads % self.size:
+            raise MXNetError(
+                f"tensor parallelism over {self.size} ranks needs a head "
+                f"count divisible by it; got {num_heads} heads")
+        return num_heads // self.size
+
+
+_tls = threading.local()
+
+
+def current():
+    """The active :class:`TPContext`, or ``None`` (single-device math)."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextmanager
+def activate(ctx):
+    prev = current()
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+# -- graph hooks (identity without an active context) ----------------------
+
+def tp_copy(x):
+    """Megatron *f*: identity forward, gradient psum over 'tp'. Place at
+    the ENTRY of each tensor-parallel region so everything upstream
+    (replicated activations, dp-sharded parameters) receives the full,
+    tp-invariant gradient."""
+    ctx = current()
+    if ctx is None:
+        return x
+    from ..ops.registry import apply_op
+
+    return apply_op("tp_copy", x, axis=ctx.axis)
+
+
+def tp_sum(x):
+    """Megatron *g*: psum over 'tp' forward, identity gradient — the exit
+    of a row-parallel layer (its local output is a partial sum)."""
+    ctx = current()
+    if ctx is None:
+        return x
+    from ..ops.registry import apply_op
+
+    return apply_op("tp_sum", x, axis=ctx.axis)
+
+
+def tp_gather(x, dim=-1):
+    """Tiled all_gather over 'tp' forward, slice-own-chunk gradient — the
+    exit of a column-parallel layer into replicated math. Forward is a
+    concatenation: the merged activations are bitwise the unsharded
+    model's (the serving parity contract)."""
+    ctx = current()
+    if ctx is None:
+        return x
+    from ..ops.registry import apply_op
+
+    d = dim if dim >= 0 else x.ndim + dim
+    return apply_op("tp_gather", x, axis=ctx.axis, size=ctx.size, dim=d)
+
+
+# -- host layout arithmetic -------------------------------------------------
+
+def tp_dim(spec, axis=TP_AXIS):
+    """Index of the dimension ``spec`` shards over ``axis``, or None."""
+    dims = []
+    for i, e in enumerate(tuple(spec)):
+        names = tuple(e) if isinstance(e, (tuple, list)) else (e,)
+        if axis in names:
+            dims.append(i)
+    if not dims:
+        return None
+    if len(dims) > 1:
+        raise MXNetError(
+            f"partition spec {spec} names '{axis}' on more than one "
+            "dimension; tensor parallelism shards exactly one")
+    return dims[0]
+
+
+def _check_divisible(shape, dim, size, segments, what="parameter"):
+    n = int(shape[dim])
+    want = size * segments
+    if n % want:
+        seg = f" x segments={segments}" if segments > 1 else ""
+        raise MXNetError(
+            f"{what} dimension {dim} of extent {n} is not divisible by "
+            f"tp={size}{seg}")
+
+
+def local_shape(shape, dim, size, segments=1):
+    _check_divisible(shape, dim, size, segments)
+    s = list(shape)
+    s[dim] //= size
+    return tuple(s)
+
+
+def _seg_view(arr, dim, size, segments):
+    import numpy as onp
+
+    a = onp.asarray(arr)
+    n = a.shape[dim]
+    pre, post = a.shape[:dim], a.shape[dim + 1:]
+    v = a.reshape(pre + (segments, size, n // (size * segments)) + post)
+    return a, v, pre, post
+
+
+def local_slice(arr, dim, rank, size, segments=1):
+    """Rank ``rank``'s local image of a full host array: the r-th 1/size
+    chunk of each of the ``segments`` stacked blocks along ``dim``."""
+    import numpy as onp
+
+    a = onp.asarray(arr)
+    _check_divisible(a.shape, dim, size, segments)
+    _, v, pre, post = _seg_view(a, dim, size, segments)
+    out = onp.take(v, int(rank), axis=len(pre) + 1)
+    return onp.ascontiguousarray(
+        out.reshape(pre + (a.shape[dim] // size,) + post))
+
+
+def merge_local(parts, dim, segments=1):
+    """Inverse of :func:`local_slice` over all ranks: per-rank local
+    images back to the full array (pure index permutation — bitwise)."""
+    import numpy as onp
+
+    size = len(parts)
+    p0 = onp.asarray(parts[0])
+    pre, post = p0.shape[:dim], p0.shape[dim + 1:]
+    loc = p0.shape[dim]
+    if loc % segments:
+        raise MXNetError(
+            f"local extent {loc} not divisible by segments={segments}")
+    stk = onp.stack(
+        [onp.asarray(p).reshape(pre + (segments, loc // segments) + post)
+         for p in parts], axis=len(pre) + 1)
+    return onp.ascontiguousarray(
+        stk.reshape(pre + (size * loc,) + post))
+
+
+def global_image(arr, dim, size, segments=1):
+    """Permutation of the FULL array whose contiguous 1/size blocks along
+    ``dim`` are the per-rank local images — the host layout a flat
+    bucket sharded tp-major sees. Identity when ``segments == 1``."""
+    import numpy as onp
+
+    if segments == 1:
+        return onp.asarray(arr)
+    a, v, pre, post = _seg_view(arr, dim, size, segments)
+    k = len(pre)
+    return onp.ascontiguousarray(onp.swapaxes(v, k, k + 1).reshape(a.shape))
+
+
+def from_global_image(arr, dim, size, segments=1):
+    """Inverse of :func:`global_image`."""
+    import numpy as onp
+
+    if segments == 1:
+        return onp.asarray(arr)
+    a = onp.asarray(arr)
+    n = a.shape[dim]
+    pre, post = a.shape[:dim], a.shape[dim + 1:]
+    v = a.reshape(pre + (size, segments, n // (size * segments)) + post)
+    k = len(pre)
+    return onp.ascontiguousarray(onp.swapaxes(v, k, k + 1).reshape(a.shape))

@@ -216,7 +216,7 @@ class DecodeEngine:
                  kv_pages=None, speculate_k=None, draft=None,
                  prefix_cache=None, max_wait_us=None, deadline_ms=None,
                  max_queue=None, cache_dir=None, manifest=None,
-                 programs=None):
+                 programs=None, tp=None):
         from ... import telemetry as _tm
         from ...context import enable_compilation_cache
 
@@ -259,12 +259,15 @@ class DecodeEngine:
             speculate_k = max(1, int(speculate_k))
             if prefix_cache is None:
                 prefix_cache = bool(_env_int("MXTPU_PREFIX_CACHE", 1))
+            if tp is None:
+                tp = _env_int("MXTPU_SERVE_TP", 1)
             self.programs = DecodePrograms(
                 model, num_slots=num_slots, max_len=max_len,
                 prefill_batch=prefill_batch,
                 max_prompt_len=max_prompt_len,
                 page_tokens=page_tokens, kv_pages=kv_pages,
-                speculate_k=speculate_k, prefix_cache=prefix_cache)
+                speculate_k=speculate_k, prefix_cache=prefix_cache,
+                tp=max(1, int(tp)))
         self.num_slots = self.programs.num_slots
         self.max_len = self.programs.max_len
         self.max_prompt_len = self.programs.max_prompt_len

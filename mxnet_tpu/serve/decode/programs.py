@@ -82,7 +82,7 @@ class DecodePrograms:
     def __init__(self, model=None, *, num_slots, max_len, prefill_batch=4,
                  max_prompt_len=None, min_prompt_bucket=8, page_tokens=128,
                  kv_pages=None, speculate_k=1, prefix_cache=True,
-                 _from_export=None):
+                 tp=1, partition_rules=None, _from_export=None):
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
         self.prefill_batch = int(prefill_batch)
@@ -126,6 +126,39 @@ class DecodePrograms:
         self._signatures = {}   # str key -> trace signature
         self.cache_shape = None  # [kv_pages, layers, heads, page_tokens, hd]
         self.cache_dtype = "float32"
+        # tensor parallelism: the model's column-parallel serve layout,
+        # traced at per-rank local shapes and replayed under shard_map
+        # over a {'tp': tp} mesh — merged activations are concatenations,
+        # so the served tokens stay BITWISE the unsharded model's
+        self.tp = max(1, int(tp))
+        self._mesh = None
+        self._tp_places = {}     # param name -> (sharded dim, segments)
+        self._in_shardings = {}  # program key -> per-arg NamedShardings
+        if self.tp > 1:
+            if _from_export is not None:
+                raise MXNetError(
+                    "tensor-parallel serving cannot load an export — "
+                    "re-trace from the live model with tp set")
+            if model is None:
+                raise MXNetError("DecodePrograms needs a model for tp >= 2")
+            if partition_rules is None:
+                maker = getattr(model, "tp_partition_rules", None)
+                if maker is None:
+                    raise MXNetError(
+                        "tp >= 2 needs partition_rules (or a model exposing "
+                        "tp_partition_rules('serve'))")
+                partition_rules = maker("serve")
+            import jax
+
+            from ...parallel.mesh import make_mesh
+
+            if len(jax.devices()) < self.tp:
+                raise MXNetError(
+                    f"tp={self.tp} needs that many devices; "
+                    f"{len(jax.devices())} visible")
+            self._mesh = make_mesh({"tp": self.tp},
+                                   devices=jax.devices()[:self.tp])
+        self._tp_rules = partition_rules
         if _from_export is not None:
             self._load_export(_from_export)
         else:
@@ -146,26 +179,96 @@ class DecodePrograms:
     def _trace_all(self):
         from ... import autograd
 
+        if self.tp > 1:
+            self._trace_all_tp()
+            return
         params = self._collect_params()
         self._params = {name: arr._data for name, arr in params}
-        names = [name for name, _ in params]
         with autograd.pause():
-            K = self.speculate_k
-            self._cops[f"decode:{K}"] = self._trace_decode(K, params)
-            self._graph_params[f"decode:{K}"] = names
-            for T in self.len_ladder:
-                self._cops[f"prefill:{T}"] = self._trace_prefill(T, params)
-                self._graph_params[f"prefill:{T}"] = names
-                if self.prefix_cache:
-                    self._cops[f"prefill_ext:{T}"] = \
-                        self._trace_prefill_ext(T, params)
-                    self._graph_params[f"prefill_ext:{T}"] = names
+            self._trace_graphs(params)
+
+    def _trace_graphs(self, params):
+        names = [name for name, _ in params]
+        K = self.speculate_k
+        self._cops[f"decode:{K}"] = self._trace_decode(K, params)
+        self._graph_params[f"decode:{K}"] = names
+        for T in self.len_ladder:
+            self._cops[f"prefill:{T}"] = self._trace_prefill(T, params)
+            self._graph_params[f"prefill:{T}"] = names
+            if self.prefix_cache:
+                self._cops[f"prefill_ext:{T}"] = \
+                    self._trace_prefill_ext(T, params)
+                self._graph_params[f"prefill_ext:{T}"] = names
+
+    def _trace_all_tp(self):
+        """Trace every graph at per-rank LOCAL shapes: column-parallel
+        parameters are temporarily swapped to their rank-0 local slices
+        under an active serve-mode TPContext (the model emits tp_gather
+        merges and sizes heads locally), then restored. Device residency
+        for the compiled programs is the segment-permuted GLOBAL image of
+        each sharded parameter, laid out so contiguous 1/tp blocks over
+        'tp' ARE the per-rank local images."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ... import autograd
+        from ...ndarray.ndarray import NDArray
+        from ...parallel import tp as _tpm
+        from ...parallel.partition import match_partition_rules
+
+        plist = [(name, p)
+                 for name, p in self._model.collect_params().items()
+                 if p._data is not None]
+        specs = match_partition_rules(
+            self._tp_rules, {n: p.data() for n, p in plist}, with_meta=True)
+        places = {}
+        for n, _ in plist:
+            dim = _tpm.tp_dim(specs[n].spec)
+            if dim is not None:
+                places[n] = (dim, int(specs[n].meta.get("segments", 1)))
+        self._tp_places = places
+        for n, p in plist:
+            full = p.data()._data
+            if n in places:
+                dim, seg = places[n]
+                img = _tpm.global_image(onp.asarray(full), dim, self.tp,
+                                        seg)
+                ax = [None] * img.ndim
+                ax[dim] = "tp"
+                self._params[n] = jax.device_put(
+                    jnp.asarray(img), NamedSharding(self._mesh, P(*ax)))
+            else:
+                self._params[n] = jax.device_put(
+                    full, NamedSharding(self._mesh, P()))
+        swapped = []
+        ctx = _tpm.TPContext(self.tp, mode="serve")
+        try:
+            for n, p in plist:
+                if n in places:
+                    dim, seg = places[n]
+                    loc = _tpm.local_slice(p.data().asnumpy(), dim, 0,
+                                           self.tp, seg)
+                    swapped.append((p, p._data))
+                    p._data = NDArray(jnp.asarray(loc))
+            params = [(n, p.data()) for n, p in plist]
+            with _tpm.activate(ctx), autograd.pause():
+                self._trace_graphs(params)
+        finally:
+            for p, full in swapped:
+                p._data = full
 
     def _pool_pair(self):
         kp, vp = self._model.init_paged_cache(self.kv_pages,
                                               self.page_tokens)
         if self.cache_shape is None:
-            self.cache_shape = tuple(int(d) for d in kp.shape)
+            shape = tuple(int(d) for d in kp.shape)
+            if self.tp > 1:
+                # the traced pool is per-rank local over heads; report the
+                # GLOBAL pool geometry the engine allocates
+                shape = shape[:2] + (shape[2] * self.tp,) + shape[3:]
+            self.cache_shape = shape
             self.cache_dtype = str(kp.dtype)
         return kp, vp
 
@@ -285,9 +388,13 @@ class DecodePrograms:
             else:
                 donate = self._PREFILL_DONATE
             examples += [self._zeros((batch, Wt), "int32"), kp, vp]
-        args = examples + [self._params[n]
-                           for n in self._graph_params[self._cop_key(key)]]
-        prog = _compile(cop, args, donate)
+        if self.tp > 1:
+            prog = self._compile_tp(key, cop, examples, donate)
+        else:
+            args = examples + [self._params[n]
+                               for n in self._graph_params[
+                                   self._cop_key(key)]]
+            prog = _compile(cop, args, donate)
         self._programs[key] = prog
         # per-program XLA cost, captured once per compile; run() credits
         # the flops counter with it at every dispatch
@@ -307,6 +414,51 @@ class DecodePrograms:
             return f"decode:{key[1]}"
         return f"{key[0]}:{key[2]}"
 
+    def _compile_tp(self, key, cop, examples, donate):
+        """AOT-compile one graph under shard_map on the 'tp' mesh: KV
+        pools shard over the head axis, column-parallel params over their
+        declared dim, everything else replicated. The executable bakes
+        these input shardings, so ``run`` device_puts its operands to the
+        recorded layouts before every call."""
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ...parallel.mesh import shard_map_compat
+
+        names = self._graph_params[self._cop_key(key)]
+        pool = P(None, None, "tp")
+        data_specs = [P()] * (len(examples) - 2) + [pool, pool]
+        pspecs = []
+        for n in names:
+            if n in self._tp_places:
+                ax = [None] * self._params[n].ndim
+                ax[self._tp_places[n][0]] = "tp"
+                pspecs.append(P(*ax))
+            else:
+                pspecs.append(P())
+        in_specs = tuple(data_specs + pspecs)
+        n_aux = len(getattr(cop, "_aux_targets", ()) or ())
+        out_specs = (P(), pool, pool) + (P(),) * n_aux
+        off = 1 if cop._uses_rng else 0
+        if off:
+            in_specs = (P(),) + in_specs
+        fn = shard_map_compat(cop._raw_fn, self._mesh,
+                              in_specs=in_specs, out_specs=out_specs)
+        shardings = tuple(NamedSharding(self._mesh, s) for s in in_specs)
+        self._in_shardings[key] = shardings
+        argnums = tuple(sorted(int(i) + off for i in donate))
+        datas = [getattr(x, "_data", x) for x in examples]
+        if off:
+            datas.insert(0, jax.random.PRNGKey(0))
+        args = [jax.device_put(a, s) for a, s in zip(
+            datas + [self._params[n] for n in names], shardings)]
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*donat.*",
+                                    category=UserWarning)
+            return jax.jit(
+                fn, donate_argnums=argnums).lower(*args).compile()
+
     def run(self, key, datas):
         """Call a compiled program with raw device operands; appends the
         param tail (and a PRNG key for rng graphs) in trace order."""
@@ -318,6 +470,13 @@ class DecodePrograms:
             from ... import random as _rnd
 
             args.insert(0, _rnd._next_key())
+        if self.tp > 1:
+            # the AOT executables bake their input shardings; re-lay small
+            # host-made operands (a no-op for already-resident arrays)
+            import jax
+
+            args = [jax.device_put(getattr(a, "_data", a), s)
+                    for a, s in zip(args, self._in_shardings[key])]
         from ... import telemetry as _tm
 
         if _tm.ON:
@@ -355,6 +514,7 @@ class DecodePrograms:
             "kv_pages": self.kv_pages,
             "speculate_k": self.speculate_k,
             "prefix_cache": self.prefix_cache,
+            "tp": self.tp,
             "batch_ladder": list(self.batch_ladder),
             "len_ladder": list(self.len_ladder),
             "cache_shape": list(self.cache_shape or ()),
@@ -378,6 +538,12 @@ class DecodePrograms:
         from these files alone (``from_export``) — no model class needed,
         and with the persistent compile cache on, no XLA compiles either.
         """
+        if self.tp > 1:
+            raise MXNetError(
+                "export of a tensor-parallel decode engine is not "
+                "supported: the traced graphs hold per-rank local shapes "
+                "tied to this process's mesh — export from a tp=1 trace "
+                "and pass tp at load time instead")
         graphs = {}
         for key, cop in self._cops.items():
             fname = f"{prefix}-{key.replace(':', '_')}-symbol.json"

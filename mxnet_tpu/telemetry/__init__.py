@@ -91,6 +91,12 @@ _C_PULL_BYTES = REGISTRY.counter("kvstore.pull_bytes")
 _C_RS_BYTES = REGISTRY.counter("collective.reduce_scatter_bytes")
 _C_AG_BYTES = REGISTRY.counter("collective.all_gather_bytes")
 _C_PSUM_BYTES = REGISTRY.counter("collective.psum_bytes")
+# the same traffic attributed per mesh axis: 'dp' carries the data-parallel
+# schedule (FSDP gathers/scatters, grad all_reduces), 'tp' the in-layer
+# megatron psums/gathers, 'pp' the stage-boundary activation sends
+_C_AXIS_DP_BYTES = REGISTRY.counter("collective_bytes.dp")
+_C_AXIS_TP_BYTES = REGISTRY.counter("collective_bytes.tp")
+_C_AXIS_PP_BYTES = REGISTRY.counter("collective_bytes.pp")
 # statically-known program cost, credited at dispatch time from the
 # per-program cost table (telemetry/costs.py)
 _C_FLOPS = REGISTRY.counter("telemetry.flops")
@@ -273,19 +279,29 @@ def record_comm(push_bytes=0, pull_bytes=0):
 
 
 def record_collective(reduce_scatter_bytes=0, all_gather_bytes=0,
-                      psum_bytes=0):
+                      psum_bytes=0, tp_bytes=0, pp_bytes=0):
     """Count in-program collective traffic (per-replica payload bytes).
 
     Called at dispatch time with the statically-known sizes of the
     collectives a compiled program contains — XLA executes them where the
     host cannot count, but the program's schedule is fixed at trace time.
-    Callers guard on ``telemetry.ON``."""
+    The first three arguments are 'dp'-axis traffic and also feed the
+    per-axis attribution (``collective_bytes.dp``); ``tp_bytes`` /
+    ``pp_bytes`` attribute megatron and stage-boundary payloads to their
+    axes. Callers guard on ``telemetry.ON``."""
     if reduce_scatter_bytes:
         _C_RS_BYTES.inc(reduce_scatter_bytes)
     if all_gather_bytes:
         _C_AG_BYTES.inc(all_gather_bytes)
     if psum_bytes:
         _C_PSUM_BYTES.inc(psum_bytes)
+    dp_bytes = reduce_scatter_bytes + all_gather_bytes + psum_bytes
+    if dp_bytes:
+        _C_AXIS_DP_BYTES.inc(dp_bytes)
+    if tp_bytes:
+        _C_AXIS_TP_BYTES.inc(tp_bytes)
+    if pp_bytes:
+        _C_AXIS_PP_BYTES.inc(pp_bytes)
 
 
 def record_fsdp(layer_bytes):

@@ -129,13 +129,13 @@ class _Program:
     """One compiled step program + the trace metadata needed to drive it."""
 
     __slots__ = ("fn", "uses_rng", "aux_targets", "n_aux", "sharded",
-                 "fsdp", "coll_bytes", "compiled", "flops",
+                 "fsdp", "coll_bytes", "coll_bytes_tp", "compiled", "flops",
                  "bytes_accessed", "k", "accum", "health_mode",
                  "health_groups")
 
     def __init__(self, fn, uses_rng, aux_targets, sharded=False, fsdp=False,
-                 coll_bytes=(0, 0, 0), k=None, accum=1, health_mode="off",
-                 health_groups=None):
+                 coll_bytes=(0, 0, 0), coll_bytes_tp=0, k=None, accum=1,
+                 health_mode="off", health_groups=None):
         self.fn = fn
         self.uses_rng = uses_rng
         self.aux_targets = aux_targets
@@ -145,6 +145,9 @@ class _Program:
         # (reduce_scatter, all_gather, psum) bytes per call, known at build
         # time — the host's only window into in-program collective traffic
         self.coll_bytes = coll_bytes
+        # 'tp'-axis collective payload per call (megatron psums/gathers),
+        # accounted by the op fallbacks during the eager trace
+        self.coll_bytes_tp = coll_bytes_tp
         # the jax Compiled, bound at first _run via explicit lower+compile
         # (same single XLA compile the implicit jit call would pay, but
         # the executable handle stays reachable for cost_analysis)
@@ -308,15 +311,29 @@ class _FSDPState:
     the residency gauges mirror ``_ShardedOptState`` so
     ``Trainer.save_states``/``load_states`` and dashboards are mode-
     agnostic. The single-controller gather caveat applies here too.
+
+    dp x tp: a group with ``sharded == "tp"`` (a megatron rule matched it)
+    holds ONE flat bucket of the GLOBAL length ``tp * BucketSpec.padded``
+    under ``NamedSharding(mesh, P(('tp', 'dp')))`` — tp-major, so the
+    contiguous 1/tp blocks are the per-rank LOCAL flat images, each
+    dp-sharded exactly like a plain dp group. Inside the program the
+    existing per-layer ``all_gather(..., 'dp')`` then rebuilds each tp
+    rank's local image unchanged, and its AD transpose psum_scatters over
+    'dp' only (correct: tp ranks own disjoint parameters). The host
+    layouts (``parallel.tp.local_slice``/``merge_local``) are pure index
+    permutations, so the per-param checkpoint layout stays bitwise.
     """
 
-    def __init__(self, mesh, opt, trainer, train_idx, groups, state_keys):
+    def __init__(self, mesh, opt, trainer, train_idx, groups, state_keys,
+                 tp_places=None, tp_size=1):
         self.mesh = mesh
         self.opt = opt
         self.trainer = trainer
         self.train_idx = train_idx
         self.groups = groups   # [(layer, dtype, ks, BucketSpec, sharded)]
         self.state_keys = state_keys
+        self.tp_places = tp_places or {}  # train pos k -> (dim, segments)
+        self.tp_size = int(tp_size)
         self.params = []       # per group: flat bucket jax.Array
         self.state = []        # per group: tuple over state keys
         self._where = {}       # train position k -> (group idx, slot idx)
@@ -340,7 +357,33 @@ class _FSDPState:
     def _sharding(self, sharded):
         from .parallel.mesh import replicated, shard_1d
 
+        if sharded == "tp":
+            import jax
+
+            from .parallel.mesh import P
+
+            return jax.sharding.NamedSharding(self.mesh, P(("tp", "dp")))
         return shard_1d(self.mesh) if sharded else replicated(self.mesh)
+
+    def _group_image(self, values, ks, bs, sh, dtype=None):
+        """Host flat image for one group from full per-param arrays. tp
+        groups concatenate the per-rank local flat images tp-major (each
+        independently padded to the dp extent) — the exact layout
+        ``P(('tp', 'dp'))`` shards contiguously."""
+        kw = {"dtype": dtype} if dtype is not None else {}
+        if sh != "tp":
+            return bs.flatten_host(values, **kw)
+        import numpy as onp
+
+        from .parallel import tp as _tp
+
+        outs = []
+        for r in range(self.tp_size):
+            locs = [_tp.local_slice(v, self.tp_places[k][0], r,
+                                    self.tp_size, self.tp_places[k][1])
+                    for k, v in zip(ks, values)]
+            outs.append(bs.flatten_host(locs, **kw))
+        return onp.concatenate(outs)
 
     # -- adoption -----------------------------------------------------------
     def _adopt_params(self):
@@ -348,9 +391,9 @@ class _FSDPState:
 
         tr = self.trainer
         for _, dt, ks, bs, sh in self.groups:
-            img = bs.flatten_host(
+            img = self._group_image(
                 [tr._params[self.train_idx[k]].data().asnumpy()
-                 for k in ks], dtype=dt)
+                 for k in ks], ks, bs, sh, dtype=dt)
             self.params.append(jax.device_put(img, self._sharding(sh)))
         # release the full per-param buffers; data()/set_data route here
         for k, i in enumerate(self.train_idx):
@@ -369,9 +412,13 @@ class _FSDPState:
                 continue
             idxs = [self.train_idx[k] for k in ks]
             if all(tr._states[i] is None for i in idxs):
-                spec = P("dp") if sh else P()
+                if sh == "tp":
+                    spec, length = P(("tp", "dp")), bs.padded * self.tp_size
+                else:
+                    spec, length = (P("dp"), bs.padded) if sh \
+                        else (P(), bs.padded)
                 self.state.append(tuple(
-                    zeros_sharded(self.mesh, (bs.padded,), jnp.float32,
+                    zeros_sharded(self.mesh, (length,), jnp.float32,
                                   spec)
                     for _ in keys))
             else:
@@ -390,12 +437,24 @@ class _FSDPState:
         tr = self.trainer
         sharding = self._sharding(sh)
         return tuple(
-            jax.device_put(bs.flatten_host(
-                [tr._states[self.train_idx[k]][key].asnumpy() for k in ks]),
+            jax.device_put(self._group_image(
+                [tr._states[self.train_idx[k]][key].asnumpy() for k in ks],
+                ks, bs, sh),
                 sharding)
             for key in self.state_keys)
 
     # -- Parameter provider hooks -------------------------------------------
+    def _stitch(self, flat, k, si, bs):
+        """One parameter's FULL value out of a tp group's global flat
+        bucket: merge the per-rank local images (bitwise permutation)."""
+        from .parallel import tp as _tp
+
+        off, n = bs.offsets[si], bs.sizes[si]
+        dim, seg = self.tp_places[k]
+        parts = [flat[r * bs.padded + off: r * bs.padded + off + n]
+                 .reshape(bs.shapes[si]) for r in range(self.tp_size)]
+        return _tp.merge_local(parts, dim, segments=seg)
+
     def param_ndarray(self, k):
         """Materialize one adopted parameter's FULL value (host gather of
         its group bucket) — the checkpoint/inspection path."""
@@ -403,8 +462,10 @@ class _FSDPState:
         from .ndarray.ndarray import NDArray
 
         gi, si = self._where[k]
-        bs = self.groups[gi][3]
+        _, _, _, bs, sh = self.groups[gi]
         flat = onp.asarray(self.params[gi])  # gathers every shard to host
+        if sh == "tp":
+            return NDArray(self._stitch(flat, k, si, bs))
         off, n = bs.offsets[si], bs.sizes[si]
         return NDArray(flat[off:off + n].reshape(bs.shapes[si]))
 
@@ -418,8 +479,16 @@ class _FSDPState:
         _, dt, _, bs, sh = self.groups[gi]
         flat = onp.asarray(self.params[gi]).copy()
         off, n = bs.offsets[si], bs.sizes[si]
-        flat[off:off + n] = \
-            onp.asarray(value).astype(onp.dtype(dt), copy=False).reshape(-1)
+        v = onp.asarray(value).astype(onp.dtype(dt), copy=False)
+        if sh == "tp":
+            from .parallel import tp as _tp
+
+            dim, seg = self.tp_places[k]
+            for r in range(self.tp_size):
+                flat[r * bs.padded + off: r * bs.padded + off + n] = \
+                    _tp.local_slice(v, dim, r, self.tp_size, seg).reshape(-1)
+        else:
+            flat[off:off + n] = v.reshape(-1)
         self.params[gi] = jax.device_put(flat, self._sharding(sh))
 
     # -- re-trace bracket ---------------------------------------------------
@@ -450,15 +519,19 @@ class _FSDPState:
         from .ndarray.ndarray import NDArray
 
         out = [None] * len(self.trainer._params)
-        for (_, _, ks, bs, _), st in zip(self.groups, self.state):
+        for (_, _, ks, bs, sh), st in zip(self.groups, self.state):
             for key, arr in zip(self.state_keys, st):
                 flat = onp.asarray(arr)
-                for k, off, n, shape in zip(ks, bs.offsets, bs.sizes,
-                                            bs.shapes):
+                for si, (k, off, n, shape) in enumerate(
+                        zip(ks, bs.offsets, bs.sizes, bs.shapes)):
                     i = self.train_idx[k]
                     if out[i] is None:
                         out[i] = {}
-                    out[i][key] = NDArray(flat[off:off + n].reshape(shape))
+                    if sh == "tp":
+                        out[i][key] = NDArray(self._stitch(flat, k, si, bs))
+                    else:
+                        out[i][key] = NDArray(
+                            flat[off:off + n].reshape(shape))
         return out
 
     def scatter_from_trainer(self):
@@ -483,11 +556,13 @@ class _FSDPState:
         return sum(bytes_per_replica(b) for b in self.params)
 
     def replicated_param_bytes(self):
-        """What unsharded residency would hold per replica (full weights)."""
+        """What unsharded residency would hold per replica (full weights).
+        tp groups store per-rank LOCAL shapes — scale back up."""
         import numpy as onp
 
-        return sum(bs.total * onp.dtype(dt).itemsize
-                   for _, dt, _, bs, _ in self.groups)
+        return sum(bs.total * onp.dtype(dt).itemsize *
+                   (self.tp_size if sh == "tp" else 1)
+                   for _, dt, _, bs, sh in self.groups)
 
     def per_replica_state_bytes(self):
         from .parallel.mesh import bytes_per_replica
@@ -495,8 +570,9 @@ class _FSDPState:
         return sum(bytes_per_replica(a) for st in self.state for a in st)
 
     def replicated_state_bytes(self):
-        return sum(bs.total * 4 * len(self.state_keys)
-                   for _, _, _, bs, _ in self.groups)
+        return sum(bs.total * 4 * len(self.state_keys) *
+                   (self.tp_size if sh == "tp" else 1)
+                   for _, _, _, bs, sh in self.groups)
 
 
 class CompiledTrainStep:
@@ -564,6 +640,7 @@ class CompiledTrainStep:
         self._shard_state = None
         self._fsdp_state = None
         self._fsdp_groups = None
+        self._tp_places = {}             # train pos k -> (dim, segments)
         self._fsdp_layer_bytes = ()      # [(layer, gather_b, scatter_b)]
         self._cache = {}       # input signature -> _Program
         self._train_idx = None
@@ -617,6 +694,13 @@ class CompiledTrainStep:
         from .parallel.mesh import AxisNames
 
         return int(self.mesh.shape[AxisNames.DP])
+
+    def _tp_size(self):
+        if self.mesh is None:
+            return 1
+        from .parallel.mesh import AxisNames
+
+        return max(int(self.mesh.shape.get(AxisNames.TP, 1)), 1)
 
     def _shardable(self):
         """``(ok, reason)`` for BOTH flat-bucket sharded schedules (ZeRO-1
@@ -903,11 +987,23 @@ class CompiledTrainStep:
             else fsdp_rules()
         specs = match_partition_rules(
             rules, {nm: tr._params[i].data()
-                    for nm, i in zip(names, train_idx)})
+                    for nm, i in zip(names, train_idx)}, with_meta=True)
         entries = [(k, nm, tuple(tr._params[i].data().shape),
                     str(tr._params[i].data().dtype))
                    for k, (nm, i) in enumerate(zip(names, train_idx))]
-        return fsdp_groups(entries, specs, self._dp_size())
+        tp_n = self._tp_size()
+        groups = fsdp_groups(entries, specs, self._dp_size(), tp_size=tp_n)
+        places = {}
+        if tp_n > 1:
+            from .parallel import tp as _tp
+
+            for k, nm in enumerate(names):
+                m = specs[nm]
+                dim = _tp.tp_dim(m.spec)
+                if dim is not None:
+                    places[k] = (dim, int(m.meta.get("segments", 1)))
+        self._tp_places = places
+        return groups
 
     def _build_program(self, x, y, pad=0, k=None, g=1):
         import jax
@@ -1005,6 +1101,13 @@ class CompiledTrainStep:
                     f"MXTPU_FSDP_REMAT={remat!r}: expected 'dots' (save "
                     "dot outputs), 'full' (save nothing) or 'none' (no "
                     "rematerialization)")
+        tp_n = self._tp_size()
+        if tp_n > 1 and not fsdp:
+            raise MXNetError(
+                "a mesh carrying a 'tp' axis of size >= 2 requires "
+                "shard_params=True — the megatron layouts ride the FSDP "
+                "bucket schedule")
+        tp_places = self._tp_places if (fsdp and tp_n > 1) else {}
 
         # --- in-program numerics monitor setup (MXTPU_NUMERICS) ------------
         # 'off' leaves the program structurally untouched; cheap/full add a
@@ -1014,6 +1117,11 @@ class CompiledTrainStep:
         # anyway; only full adds genuinely extra traversals (max|update|,
         # per-group norms).
         nmode = _telemetry.numerics_mode()
+        if tp_n > 1:
+            # per-group health attribution is not tp-aware (replicated
+            # groups' tp-invariant stats would double-count under a
+            # ('dp', 'tp') reduction): the in-program monitor stays off
+            nmode = "off"
         monitor = nmode != "off"
         track_upd = nmode == "full"
         health_groups = None
@@ -1060,29 +1168,56 @@ class CompiledTrainStep:
             y_t = self._pad_rows(y, pad)
         else:
             x_t, y_t = x, y
-        with ag.train_mode(), dc.context() as tctx:
-            dvars = [dc.set_variable(x_t, "data0"),
-                     dc.set_variable(y_t, "label0")]
-            wvars = [dc.set_variable(tr._params[i].data(), f"w{i}")
-                     for i in train_idx]
-            fvars = [dc.set_variable(p.data(), pname)
-                     for pname, p in frozen]
-            loss = self.loss_fn(self.net(x_t), y_t)
-            if weighted:
-                if loss.ndim == 0 or loss.shape[0] != x_t.shape[0]:
-                    raise MXNetError(
-                        "partial-batch padding needs a per-sample loss "
-                        f"(got shape {tuple(loss.shape)}); pass batches "
-                        "divisible by the dp axis or strict_batch=True")
-            else:
-                loss = loss.mean()
-            if loss._dc_sym is None:
-                self.fallback_reason = \
-                    "loss is not connected to the traced forward"
-                return None
-            entries = [loss._dc_sym] + [e for _, e in tctx.aux_updates]
-            aux_targets = [t for t, _ in tctx.aux_updates]
-            fwd, uses_rng = build_executor(entries, dvars + wvars + fvars)
+        import contextlib
+
+        tp_ctx = None
+        tp_swap = []
+        tp_scope = contextlib.nullcontext()
+        if tp_places:
+            from .parallel import tp as _tp
+
+            tp_ctx = _tp.TPContext(tp_n, mode="train")
+            tp_scope = _tp.activate(tp_ctx)
+            # trace with each megatron parameter's rank-0 LOCAL slice
+            # bound to its variable — the traced shapes are the per-rank
+            # shapes the shard_map replay feeds (trace values throwaway);
+            # the active context makes the model blocks emit the matching
+            # in-graph tp collectives
+            for kk, (dim, seg) in tp_places.items():
+                p = tr._params[train_idx[kk]]
+                tp_swap.append((p, p._data))
+                p._data = NDArray(jnp.asarray(_tp.local_slice(
+                    p._data.asnumpy(), dim, 0, tp_n, seg)))
+        try:
+            with tp_scope, ag.train_mode(), dc.context() as tctx:
+                dvars = [dc.set_variable(x_t, "data0"),
+                         dc.set_variable(y_t, "label0")]
+                wvars = [dc.set_variable(tr._params[i].data(), f"w{i}")
+                         for i in train_idx]
+                fvars = [dc.set_variable(p.data(), pname)
+                         for pname, p in frozen]
+                loss = self.loss_fn(self.net(x_t), y_t)
+                if weighted:
+                    if loss.ndim == 0 or loss.shape[0] != x_t.shape[0]:
+                        raise MXNetError(
+                            "partial-batch padding needs a per-sample loss "
+                            f"(got shape {tuple(loss.shape)}); pass batches "
+                            "divisible by the dp axis or strict_batch=True")
+                else:
+                    loss = loss.mean()
+                if loss._dc_sym is None:
+                    self.fallback_reason = \
+                        "loss is not connected to the traced forward"
+                    return None
+                entries = [loss._dc_sym] + [e for _, e in tctx.aux_updates]
+                aux_targets = [t for t, _ in tctx.aux_updates]
+                fwd, uses_rng = build_executor(entries,
+                                               dvars + wvars + fvars)
+        finally:
+            # restore the FULL per-param values: adoption (first build)
+            # slices per-rank images out of them right after
+            for p, full in tp_swap:
+                p._data = full
 
         n_train = len(train_idx)
         n_aux = len(aux_targets)
@@ -1422,8 +1557,10 @@ class CompiledTrainStep:
                     finite = jnp.logical_and(finite,
                                              jnp.all(jnp.isfinite(g)))
             # each replica inspected only its shards: AND the verdicts so
-            # the where-select agrees everywhere
-            finite = coll.all_reduce(finite.astype(jnp.int32), "dp",
+            # the where-select agrees everywhere — over BOTH axes under
+            # dp x tp (tp ranks inspect disjoint megatron shards)
+            verdict_axes = ("dp", "tp") if tp_n > 1 else "dp"
+            finite = coll.all_reduce(finite.astype(jnp.int32), verdict_axes,
                                      op="min") > 0
             overflow = jnp.logical_not(finite)
             # health: sharded groups reduce over disjoint shards (psum'd at
@@ -1552,11 +1689,17 @@ class CompiledTrainStep:
             dp = P("dp")
             if fsdp:
                 # per-leaf spec pytrees: sharded groups enter/leave as
-                # their 1/N shards, replicated pools as full copies
-                ws_spec = [dp if sh else P()
-                           for _, _, _, _, sh in groups]
-                ss_spec = tuple(dp if sh else P()
-                                for _, _, _, _, sh in groups)
+                # their 1/N shards (tp groups as 1/(tp*N) of the global
+                # tp-major bucket), replicated pools as full copies
+                tp_dp = P(("tp", "dp"))
+
+                def g_spec(sh):
+                    if sh == "tp":
+                        return tp_dp
+                    return dp if sh else P()
+
+                ws_spec = [g_spec(sh) for _, _, _, _, sh in groups]
+                ss_spec = tuple(g_spec(sh) for _, _, _, _, sh in groups)
                 out_ws = list(ws_spec)
                 out_state = ss_spec
             else:
@@ -1745,15 +1888,23 @@ class CompiledTrainStep:
         coll_bytes = self._collective_bytes(train_idx, aux_targets, buckets,
                                             bucketed, weighted, scaler_on,
                                             groups=groups, remat=remat)
+        tp_bytes = 0
+        if tp_ctx is not None:
+            # accounted by the op fallbacks while the trace replayed the
+            # model eagerly on rank-0 local values
+            tp_bytes = int(tp_ctx.psum_bytes + tp_ctx.gather_bytes)
         if multi:
             # per-dispatch payload scales with the k*g microbatches scanned
             coll_bytes = tuple(b * (k * g) for b in coll_bytes)
+            tp_bytes *= k * g
         if fsdp and self._fsdp_state is None:
             # adoption AFTER the trace (it releases the per-param buffers
             # the trace just bound); like the ZeRO-1 state, the residency
             # is per-net — every input signature's program shares it
             self._fsdp_state = _FSDPState(self.mesh, opt, tr, train_idx,
-                                          groups, state_keys)
+                                          groups, state_keys,
+                                          tp_places=tp_places,
+                                          tp_size=tp_n)
             tr._shard_state = self._fsdp_state
             gathers = 1 if remat == "none" else 2  # backward re-gather
             self._fsdp_layer_bytes = tuple(
@@ -1764,7 +1915,7 @@ class CompiledTrainStep:
         return _Program(jax.jit(fn, donate_argnums=train_donate_argnums()),
                         uses_rng,
                         aux_targets, sharded=bucketed, fsdp=fsdp,
-                        coll_bytes=coll_bytes,
+                        coll_bytes=coll_bytes, coll_bytes_tp=tp_bytes,
                         k=k if multi else None, accum=g,
                         health_mode=nmode,
                         health_groups=health_groups)
@@ -1929,7 +2080,8 @@ class CompiledTrainStep:
             # scatter + gather traffic on top of the program's own
             rs_b += self._state_bucket_bytes
             ag_b += self._state_bucket_bytes
-        _telemetry.record_collective(rs_b, ag_b, ps_b)
+        _telemetry.record_collective(rs_b, ag_b, ps_b,
+                                     tp_bytes=prog.coll_bytes_tp)
         if prog.fsdp:
             _telemetry.record_fsdp(self._fsdp_layer_bytes)
         with _telemetry.program_timer("train_step"):

@@ -144,8 +144,66 @@ def test_fsdp_groups_split_by_dtype():
     assert {g[1] for g in groups} == {"float32", "bfloat16"}
 
 
-def test_fsdp_groups_rejects_non_dp_axes():
+def test_fsdp_groups_rejects_tp_without_tp_mesh():
+    """A 'tp' rule on a dp-only mesh (tp_size=1) is rejected with a hint
+    pointing at make_mesh composition, naming the spec."""
     entries = [(0, "wq", (8, 8), "float32")]
     specs = {"wq": PS(None, "tp")}
-    with pytest.raises(MXNetError, match="five_axis"):
+    with pytest.raises(MXNetError, match=r"make_mesh"):
         fsdp_groups(entries, specs, n_shards=8)
+
+
+def test_fsdp_groups_rejects_pp_naming_rule_pattern():
+    """An unsupported-axis error must name the offending RULE pattern (not
+    just the leaf) and point pp layouts at the pipeline scheduler."""
+    from mxnet_tpu.parallel.partition import RuleMatch
+
+    entries = [(0, "blocks.0.w", (8, 8), "float32")]
+    specs = {"blocks.0.w": RuleMatch(PS("pp", None), {}, r"blocks\..*")}
+    with pytest.raises(MXNetError) as ei:
+        fsdp_groups(entries, specs, n_shards=4, tp_size=2)
+    msg = str(ei.value)
+    assert repr(r"blocks\..*") in msg      # the rule pattern, verbatim
+    assert "schedule_1f1b" in msg          # the pp hint
+
+
+def test_fsdp_groups_rejects_other_axes_with_five_axis_hint():
+    entries = [(0, "wq", (8, 8), "float32")]
+    specs = {"wq": PS(None, "sp")}
+    with pytest.raises(MXNetError, match="five_axis"):
+        fsdp_groups(entries, specs, n_shards=8, tp_size=2)
+
+
+def test_fsdp_groups_tp_local_shapes_and_segments():
+    """On a dp x tp mesh, tp leaves bucket over per-rank LOCAL shapes
+    (sharded == "tp"); segments meta splits each stacked block per rank;
+    indivisible shapes raise naming the leaf."""
+    from mxnet_tpu.parallel.partition import RuleMatch
+
+    entries = [(0, "l.qkv.weight", (24, 8), "float32"),
+               (1, "l.up.weight", (32, 8), "float32"),
+               (2, "l.down.weight", (8, 32), "float32"),
+               (3, "scale", (8,), "float32")]
+    specs = {"l.qkv.weight": RuleMatch(PS("tp", None), {"segments": 3},
+                                       r"qkv"),
+             "l.up.weight": RuleMatch(PS("tp", None), {}, r"up"),
+             "l.down.weight": RuleMatch(PS(None, "tp"), {}, r"down"),
+             "scale": RuleMatch(PS(), {}, None)}
+    groups = fsdp_groups(entries, specs, n_shards=4, tp_size=2)
+    by_layer = {g[0]: g for g in groups}
+    qkv = by_layer["l.qkv"]
+    assert qkv[4] == "tp"
+    assert qkv[3].shapes == [(12, 8)]      # each of Q/K/V halved: 3*(4,8)
+    up = by_layer["l.up"]
+    assert up[3].shapes == [(16, 8)] and up[4] == "tp"
+    down = by_layer["l.down"]
+    assert down[3].shapes == [(8, 16)] and down[4] == "tp"  # row split
+    assert by_layer["_replicated"][4] is False
+    # bucket math runs over the local shapes
+    assert qkv[3].total == 12 * 8 and qkv[3].n_shards == 4
+
+    bad = {"l.qkv.weight": RuleMatch(PS("tp", None), {"segments": 3},
+                                     r"qkv")}
+    with pytest.raises(MXNetError, match="qkv"):
+        fsdp_groups([(0, "l.qkv.weight", (25, 8), "float32")], bad,
+                    n_shards=4, tp_size=2)

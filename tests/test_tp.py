@@ -1,0 +1,378 @@
+"""Tensor parallelism inside the compiled step and the decode engine
+(ISSUE 19): megatron column/row splits declared by 'tp' partition rules
+compose with FSDP on one dp x tp mesh — same donated buffers, same
+checkpoint format — and a tp-sharded model path through the decode
+programs serves with bitwise greedy parity.
+
+Covers: dp4 x tp2 GPT training parity vs dp8 FSDP under an LR schedule
+and a DynamicLossScaler with one dispatch per step and zero steady-state
+recompiles; per-replica param-bytes gauge below 1/dp of replicated and
+per-axis collective byte attribution (collective_bytes.dp/.tp); tp
+requiring shard_params; checkpoint bitwise round-trip replicated <->
+FSDP <-> dp x tp; the 1F1B schedule and layer-range stage assignment;
+tp=2 decode greedy parity vs naive generate with zero steady-state
+recompiles and export refusal.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, gluon, initializer as init_mod, telemetry as tm
+from mxnet_tpu.amp import DynamicLossScaler
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo.gpt import gpt_tiny, gpt_tp_rules
+from mxnet_tpu.lr_scheduler import FactorScheduler
+from mxnet_tpu.parallel.mesh import make_mesh
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    tm.disable()
+    tm.reset()
+    tm.configure(watchdog_warmup_steps=1)
+    yield
+    tm.disable()
+    tm.reset()
+    tm.configure(watchdog_warmup_steps=1)
+
+
+V, B, T = 67, 8, 12
+
+
+def _batch(seed):
+    rng = onp.random.RandomState(seed)
+    x = rng.randint(0, V, size=(B, T)).astype("int32")
+    y = rng.randint(0, V, size=(B, T)).astype("int32")
+    return mx.np.array(x), mx.np.array(y)
+
+
+def _bits_equal(a, b):
+    return (onp.asarray(a, onp.float32).view(onp.uint32)
+            == onp.asarray(b, onp.float32).view(onp.uint32)).all()
+
+
+def _make_gpt(seed=0):
+    mx.random.seed(seed)
+    net = gpt_tiny(vocab_size=V, dropout=0.0)
+    net.initialize(init_mod.Normal(0.05))
+    net(_batch(0)[0])  # settle shapes
+    return net
+
+
+# -- training: dp x tp composed with FSDP ------------------------------------
+def _run_gpt(mesh_axes, rules, n_steps=5, opt="sgd", seed=0, scaler=True):
+    net = _make_gpt(seed)
+    kw = {"learning_rate": 0.05} if opt == "sgd" else {"learning_rate": 1e-3}
+    kw["lr_scheduler"] = FactorScheduler(step=2, factor=0.5)
+    tr = gluon.Trainer(net.collect_params(), opt, kw)
+    if scaler:
+        amp.attach_loss_scaler(tr, DynamicLossScaler(init_scale=256.0))
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh(mesh_axes), shard_params=True,
+                           partition_rules=rules)
+    losses = [float(step(*_batch(1)).asnumpy())]  # warmup: trace + compile
+    assert step.shard_params is True, step.shard_params_fallback_reason
+    assert step.fallback_reason is None, step.fallback_reason
+    tm.enable()
+    tm.step_report(reset=True)
+    losses += [float(step(*_batch(s + 2)).asnumpy())
+               for s in range(n_steps - 1)]
+    rows = tm.step_report(reset=True)
+    tm.disable()
+    return net, tr, step, losses, rows
+
+
+def test_dp_tp_parity_one_dispatch_zero_recompiles():
+    """Acceptance: a GPT block trains under dp x tp = 4 x 2 with ONE
+    dispatch per step and zero steady-state recompiles under an LR
+    schedule + DynamicLossScaler, tracking the dp8 FSDP trajectory (and
+    final weights) to float32 tolerance."""
+    net_r, _, _, losses_r, _ = _run_gpt({"dp": 8}, None)
+    net_t, _, step_t, losses_t, rows = _run_gpt({"dp": 4, "tp": 2},
+                                                gpt_tp_rules("train"))
+    assert len(rows) == 4
+    for row in rows:
+        assert row["dispatches"] == 1, row
+        assert row["recompiles"] == 0, row
+    assert step_t._traces == 1  # LR decay + scaler growth: one program
+    assert all(onp.isfinite(v) for v in losses_t)
+    assert onp.allclose(losses_t, losses_r, rtol=1e-4, atol=1e-5), \
+        onp.abs(onp.array(losses_t) - onp.array(losses_r)).max()
+    for (name, pa), (_, pb) in zip(net_t.collect_params().items(),
+                                   net_r.collect_params().items()):
+        a, b = pa.data().asnumpy(), pb.data().asnumpy()
+        assert a.shape == b.shape, name
+        assert onp.allclose(a, b, rtol=2e-4, atol=2e-5), \
+            f"{name}: maxdiff={onp.abs(a - b).max():.3e}"
+
+
+def test_tp_residency_gauge_and_axis_byte_attribution():
+    """Under dp4 x tp2 the per-replica param-bytes gauge lands below 1/dp
+    of replicated (each replica holds 1/(dp*tp) of the megatron groups),
+    and every dispatch books its traffic per axis: collective_bytes.dp
+    (FSDP gathers/scatters) and collective_bytes.tp (megatron psums /
+    gathers) both advance; .pp stays zero."""
+    _, _, step, _, _ = _run_gpt({"dp": 4, "tp": 2}, gpt_tp_rules("train"),
+                                n_steps=2, scaler=False)
+    st = step._fsdp_state
+    per_p = tm.gauge("train_step.param_bytes_per_replica").value
+    rep_p = tm.gauge("train_step.param_bytes_replicated").value
+    assert per_p == st.per_replica_param_bytes() > 0
+    assert rep_p == st.replicated_param_bytes() > 0
+    pad_p = sum((bs.padded - bs.total) * onp.dtype(dt).itemsize
+                * (2 if sh == "tp" else 1)
+                for _, dt, _, bs, sh in st.groups if sh)
+    assert per_p <= rep_p / 4 + pad_p          # below 1/dp: tp pays off
+    assert any(sh == "tp" for _, _, _, _, sh in st.groups)
+
+    tm.enable()
+    dp0 = tm.counter("collective_bytes.dp").value
+    tp0 = tm.counter("collective_bytes.tp").value
+    pp0 = tm.counter("collective_bytes.pp").value
+    step(*_batch(9))
+    assert tm.counter("collective_bytes.dp").value > dp0
+    assert tm.counter("collective_bytes.tp").value > tp0
+    assert tm.counter("collective_bytes.pp").value == pp0 == 0
+
+
+def test_tp_requires_shard_params():
+    """The megatron layouts ride the FSDP bucket machinery: a tp mesh
+    with shard_params explicitly off is a build-time error."""
+    net = _make_gpt(seed=3)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    step = tr.compile_step(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                           mesh=make_mesh({"dp": 4, "tp": 2}),
+                           shard_params=False,
+                           partition_rules=gpt_tp_rules("train"))
+    with pytest.raises(MXNetError, match="shard_params=True"):
+        step(*_batch(0))
+
+
+# -- checkpointing across residency modes ------------------------------------
+_MODES = {
+    "replicated": (({"dp": 8}), None, False),
+    "fsdp": (({"dp": 8}), None, True),
+    "dptp": (({"dp": 4, "tp": 2}), "rules", True),
+}
+
+
+def _make_mode(mode, seed=4):
+    mesh_axes, rules, shard = _MODES[mode]
+    net = _make_gpt(seed)
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+    step = tr.compile_step(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        mesh=make_mesh(mesh_axes), shard_params=shard, shard_update=False,
+        partition_rules=gpt_tp_rules("train") if rules else None)
+    return net, tr, step
+
+
+def _full_states(tr):
+    if tr._shard_state is not None:
+        return tr._shard_state.gather_states()
+    return tr._states
+
+
+@pytest.mark.parametrize("first,second", [
+    ("dptp", "replicated"), ("replicated", "dptp"), ("dptp", "fsdp")])
+def test_tp_checkpoint_roundtrip_bitwise(tmp_path, first, second):
+    """Checkpoints keep the classic per-param layout under dp x tp too:
+    save in one residency mode, load into another — weights and optimizer
+    state restore BITWISE (the tp global images are pure index
+    permutations of the shard buckets), and training resumes."""
+    batches = [_batch(seed=s) for s in range(4)]
+    pfile = str(tmp_path / "net.params")
+    sfile = str(tmp_path / "trainer.states")
+
+    net_a, tr_a, step_a = _make_mode(first)
+    for x, y in batches[:2]:
+        step_a(x, y)
+    net_a.save_parameters(pfile)
+    tr_a.save_states(sfile)
+    w_snap = {n: p.data().asnumpy() for n, p in
+              net_a.collect_params().items()}
+    st_snap = [None if st is None else {k: v.asnumpy()
+                                        for k, v in st.items()}
+               for st in _full_states(tr_a)]
+
+    net_b, tr_b, step_b = _make_mode(second)
+    if second in ("fsdp", "dptp"):
+        step_b(*batches[0])  # adopt params into buckets, then write through
+    net_b.load_parameters(pfile)
+    tr_b.load_states(sfile)
+    for n, p in net_b.collect_params().items():
+        assert _bits_equal(p.data().asnumpy(), w_snap[n]), f"weight {n}"
+    for st0, st1 in zip(st_snap, _full_states(tr_b)):
+        if st0 is None:
+            continue
+        for k in st0:
+            assert _bits_equal(st0[k], st1[k].asnumpy()), f"state {k}"
+    for x, y in batches[2:]:
+        assert onp.isfinite(float(step_b(x, y).asnumpy()))
+
+
+# -- pipeline schedule vocabulary --------------------------------------------
+def test_layer_ranges_contiguous_remainder_to_earlier_stages():
+    from mxnet_tpu.parallel.pipeline import layer_ranges
+
+    assert layer_ranges(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+    ranges = layer_ranges(10, 4)
+    assert ranges == [(0, 3), (3, 6), (6, 8), (8, 10)]  # remainder early
+    assert ranges[0][0] == 0 and ranges[-1][1] == 10
+    assert all(a[1] == b[0] for a, b in zip(ranges, ranges[1:]))
+    with pytest.raises(MXNetError, match="at least one layer"):
+        layer_ranges(3, 4)
+
+
+def test_schedule_1f1b_properties():
+    """Every stage runs M forwards and M backwards, each microbatch's
+    backward after its forward; warmup depth is min(S - s - 1, M); the
+    in-flight activation stash never exceeds S - s (the 1F1B memory
+    bound, vs GPipe's M); the last stage strictly alternates F/B."""
+    from mxnet_tpu.parallel.pipeline import schedule_1f1b
+
+    S, M = 4, 8
+    sched = schedule_1f1b(S, M)
+    assert len(sched) == S
+    for s, actions in enumerate(sched):
+        fs = [i for op, i in actions if op == "F"]
+        bs = [i for op, i in actions if op == "B"]
+        assert fs == list(range(M)) and bs == list(range(M))
+        for i in range(M):
+            assert actions.index(("F", i)) < actions.index(("B", i))
+        warmup = 0
+        for op, _ in actions:
+            if op == "B":
+                break
+            warmup += 1
+        assert warmup == min(S - s - 1, M) + 1  # warmup fwds + 1st steady F
+        live = peak = 0
+        for op, _ in actions:
+            live += 1 if op == "F" else -1
+            peak = max(peak, live)
+        assert peak <= S - s
+    last = sched[-1]
+    assert all(op == ("F" if j % 2 == 0 else "B")
+               for j, (op, _) in enumerate(last))
+    with pytest.raises(MXNetError):
+        schedule_1f1b(0, 4)
+    assert schedule_1f1b(1, 3) == [
+        (("F", 0), ("B", 0), ("F", 1), ("B", 1), ("F", 2), ("B", 2))]
+
+
+# -- serving: tp-sharded decode ----------------------------------------------
+SERVE_VOCAB, SERVE_LEN = 97, 64
+
+
+@pytest.fixture(scope="module")
+def tp_engine():
+    from mxnet_tpu.serve.decode import DecodeEngine
+
+    mx.random.seed(11)
+    net = gpt_tiny(vocab_size=SERVE_VOCAB, dropout=0.0, num_layers=2,
+                   units=32, num_heads=4, max_length=SERVE_LEN)
+    net.initialize()
+    eng = DecodeEngine(net, num_slots=4, max_len=SERVE_LEN,
+                       max_prompt_len=16, prefill_batch=4, page_tokens=8,
+                       speculate_k=1, prefix_cache=True, cache_dir=False,
+                       tp=2)
+    eng.warmup()
+    yield net, eng
+    eng.close()
+
+
+def _prompts(n, seed=0):
+    rs = onp.random.RandomState(seed)
+    return [[int(t) for t in rs.randint(1, SERVE_VOCAB,
+                                        size=rs.randint(1, 16))]
+            for _ in range(n)]
+
+
+def test_decode_tp2_greedy_parity(tp_engine):
+    """One engine, model column-sharded tp=2 over a {'tp': 2} mesh: the
+    merges are concatenations, so greedy output is BITWISE the unsharded
+    model's naive generate."""
+    net, eng = tp_engine
+    assert eng.programs.tp == 2
+    prompts = _prompts(6)
+    streams = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    for p, s in zip(prompts, streams):
+        got = s.result(timeout=300)
+        want = net.generate(p, max_new_tokens=8, temperature=0.0,
+                            use_cache=False)[len(p):]
+        assert got == [int(t) for t in want], (p, got)
+
+
+def test_decode_tp2_zero_steady_state_recompiles(tp_engine):
+    """Ragged arrivals join/leave the tp-sharded decode tick with zero
+    recompiles beyond warmup — the same contract as tp=1."""
+    _, eng = tp_engine
+    for s in [eng.submit(p, max_new_tokens=4) for p in _prompts(4, seed=1)]:
+        s.result(timeout=300)  # populate every program family
+    tm.enable()
+    r0 = tm.counter("jit.recompiles").value
+    streams = [eng.submit(p, max_new_tokens=6)
+               for p in _prompts(8, seed=2)]
+    for s in streams:
+        assert len(s.result(timeout=300)) > 0
+    assert tm.counter("jit.recompiles").value == r0
+
+
+def test_decode_tp_manifest_and_export_refused(tp_engine, tmp_path):
+    """The warmup manifest records the tp width; exporting a tp trace is
+    refused (per-rank local graphs are not a portable artifact)."""
+    _, eng = tp_engine
+    assert eng.programs.manifest_dict()["tp"] == 2
+    with pytest.raises(MXNetError, match="tp"):
+        eng.programs.export(str(tmp_path / "gpt.decode"))
+
+
+def test_decode_tp_kv_pool_sharded_over_heads(tp_engine):
+    """The paged KV pool is head-sharded over tp: the reported (global)
+    cache shape keeps the full head count while each rank holds half."""
+    import jax
+
+    net, eng = tp_engine
+    heads = net._num_heads if hasattr(net, "_num_heads") else 4
+    cache_shape = eng.programs.cache_shape
+    assert cache_shape[2] == heads  # global heads, tp-merged
+    pools = [x for x in jax.live_arrays()
+             if getattr(x, "ndim", 0) == len(cache_shape)
+             and tuple(x.shape) == tuple(cache_shape)]
+    assert pools  # device residency exists at the global shape
+
+
+# -- bench wiring ------------------------------------------------------------
+def test_bench_train_step_tp_small(monkeypatch):
+    """bench.py train_step --mesh dp4xtp2 (small model): one dispatch per
+    step, no recompiles, per-replica param bytes below 1/dp of replicated,
+    and the collective traffic split per axis."""
+    import bench
+
+    monkeypatch.setenv("BENCH_TRAIN_STEP_SMALL", "1")
+    monkeypatch.setenv("BENCH_MESH", "dp4xtp2")
+    r = bench.bench_train_step_tp()
+    assert r["dispatches_per_step"] == 1, r
+    assert r["recompiles_after_warmup"] == 0, r
+    assert r["compiled_programs"] == 1, r
+    assert r["dp_size"] == 4 and r["tp_size"] == 2, r
+    assert 0 < r["param_bytes_per_replica"] \
+        <= r["param_bytes_replicated"] / 4, r
+    assert r["collective_bytes_dp_per_step"] > 0, r
+    assert r["collective_bytes_tp_per_step"] > 0, r
+    assert r["value"] > 0 and r["vs_baseline"] > 0, r
+
+
+def test_bench_serve_llm_tp_small(monkeypatch):
+    """bench.py serve_llm --tp 2 (small config): the engine serves the
+    tp-sharded model with zero steady-state compiles (the bench itself
+    asserts bitwise engine-vs-naive greedy parity before timing)."""
+    import bench
+
+    monkeypatch.setenv("BENCH_SERVE_LLM_SMALL", "1")
+    monkeypatch.setenv("BENCH_SERVE_TP", "2")
+    r = bench.bench_serve_llm()
+    assert r["tp"] == 2, r
+    assert r["compiles_steady"] == 0, r
+    assert r["shed"] == 0 and r["evicted"] == 0, r
+    assert r["value"] > 0 and r["vs_baseline"] > 0, r

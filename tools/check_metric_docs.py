@@ -1,6 +1,7 @@
 #!/usr/bin/env python3
 """Lint: every ``serve.*`` / ``telemetry.*`` / ``checkpoint.*`` /
-``fault.*`` / ``train.*`` metric name created anywhere in ``mxnet_tpu/``
+``fault.*`` / ``train.*`` / ``collective.*`` / ``collective_bytes.*``
+metric name created anywhere in ``mxnet_tpu/``
 must appear in docs/DESIGN.md (the Observability metric inventory), and
 every ``MXTPU_*`` environment variable actually read from the
 environment must appear in docs/ENV_VARS.md — so the exported
@@ -31,7 +32,9 @@ ENV_VARS = ROOT / "docs" / "ENV_VARS.md"
 # Histogram("serve.ttft_ms", ...)
 _CREATE = re.compile(
     r"(?:counter|gauge|timer|histogram|Counter|Gauge|Timer|Histogram)\(\s*"
-    r"(f?)([\"'])((?:serve|telemetry|checkpoint|fault|train|mem|numerics)"
+    r"(f?)([\"'])"
+    r"((?:serve|telemetry|checkpoint|fault|train|mem|numerics"
+    r"|collective_bytes|collective)"
     r"\.[^\"']*)\2")
 
 
