@@ -626,6 +626,31 @@ def compilation_cache_dir() -> str | None:
     return os.path.join(root, _probe_env_signature())
 
 
+def tuning_cache_path() -> str | None:
+    """On-disk kernel tuning cache (``tune/``) for THIS environment, or
+    None when persistence is disabled.
+
+    Default: ``tuning_cache.json`` inside :func:`compilation_cache_dir` —
+    tuned block winners are only as valid as the compiled programs they
+    were measured in, so they live and die with the same
+    environment-signature directory. ``MXTPU_TUNE_CACHE`` overrides the
+    full path (the tune layer still refuses a file whose recorded env
+    signature differs); ``MXTPU_TUNE_CACHE=off`` disables persistence
+    while leaving the in-process tier working.
+    """
+    import os
+
+    override = os.environ.get("MXTPU_TUNE_CACHE", "")
+    if override.lower() in ("0", "off", "none", "disabled"):
+        return None
+    if override:
+        return override
+    d = compilation_cache_dir()
+    if not d:
+        return None
+    return os.path.join(d, "tuning_cache.json")
+
+
 def enable_compilation_cache(path=None):
     """Point jax's persistent compilation cache at ``path`` (default:
     :func:`compilation_cache_dir`) so compiled XLA programs survive the

@@ -28,12 +28,46 @@ from jax import lax
 
 import os as _os
 
-# flash-attention tile sizes; env-overridable so on-chip sweeps can tune
-# per shape class without code changes (powers of two; Q also a multiple
-# of 8, K of 128, to stay Mosaic-tileable)
-DEFAULT_BLOCK_Q = int(_os.environ.get("MXTPU_FLASH_BLOCK_Q", 256))
-DEFAULT_BLOCK_K = int(_os.environ.get("MXTPU_FLASH_BLOCK_K", 512))
 _NEG_INF = -1e30
+
+# flash-attention tile-size floors (Mosaic minimum tiles: 8 sublanes on
+# the Q axis, 128 lanes on the K axis)
+_MIN_BLOCK_Q = 8
+_MIN_BLOCK_K = 128
+
+
+def _validated_block_env(name, default, min_tile) -> int:
+    """Block size from env var ``name`` — read PER CALL, not at import,
+    so tests and the tuner can vary it without reloading the module.
+    Must be a power of two >= the Mosaic minimum tile for its axis."""
+    from ..base import MXNetError
+
+    raw = _os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise MXNetError(
+            f"{name}={raw!r} is not an integer; expected a power of two "
+            f">= {min_tile}") from None
+    if v < min_tile or (v & (v - 1)) != 0:
+        raise MXNetError(
+            f"{name}={v} is invalid: flash-attention block sizes must be "
+            f"powers of two >= {min_tile} (the Mosaic minimum tile for "
+            "this axis)")
+    return v
+
+
+def flash_block_q() -> int:
+    """Default Q-axis tile (``MXTPU_FLASH_BLOCK_Q``, default 256) — the
+    starting point the tuner measures against, not a frozen constant."""
+    return _validated_block_env("MXTPU_FLASH_BLOCK_Q", 256, _MIN_BLOCK_Q)
+
+
+def flash_block_k() -> int:
+    """Default K-axis tile (``MXTPU_FLASH_BLOCK_K``, default 512)."""
+    return _validated_block_env("MXTPU_FLASH_BLOCK_K", 512, _MIN_BLOCK_K)
 
 
 def _on_tpu() -> bool:
@@ -55,6 +89,47 @@ def _interpret() -> bool:
 
 def _use_pallas() -> bool:
     return _HAVE_PALLAS and (_on_tpu() or _interpret())
+
+
+# ---------------------------------------------------------------------------
+# tuned-config resolution (trace-time only — block sizes are static args
+# of the compiled programs, so steady state never pays a lookup)
+# ---------------------------------------------------------------------------
+def _tune_cache():
+    from ..tune import cache
+
+    return cache
+
+
+def _resolve_attention_blocks(kernel, q, k, causal, seg):
+    """(block_q, block_k) for this trace, or None for the XLA lowering.
+
+    ``kernel`` is ``"flash_fwd"`` or ``"flash_bwd"`` — the two are tuned
+    independently (their grids iterate opposite axes). With tuning off
+    this returns the env-default blocks, byte-identical to the pre-tuner
+    behavior; with tuning on, a miss or a tuned Pallas loss returns None
+    so the caller takes the XLA path (never silently slower)."""
+    tc = _tune_cache()
+    cfg = tc.resolve(kernel, tc.key_attention(
+        kernel, q.shape, k.shape, q.dtype, causal, seg))
+    if cfg == "default":
+        return flash_block_q(), flash_block_k()
+    if cfg == "xla":
+        return None
+    return (int(cfg.get("block_q", flash_block_q())),
+            int(cfg.get("block_k", flash_block_k())))
+
+
+def _resolve_block_rows(kernel, rows, d, dtype):
+    """block_rows for a row-wise kernel (``"layer_norm"``/``"softmax"``),
+    or 0 for the XLA lowering."""
+    tc = _tune_cache()
+    cfg = tc.resolve(kernel, tc.key_rows(kernel, rows, d, dtype))
+    if cfg == "default":
+        return 128
+    if cfg == "xla":
+        return 0
+    return int(cfg.get("block_rows", 128))
 
 
 # ---------------------------------------------------------------------------
@@ -245,34 +320,51 @@ def flash_attention(q, k, v, scale=None, causal=False, q_segment_ids=None,
     Ragged sequence lengths (not block-divisible, e.g. BERT T=384) stay on
     the fused path: operands are padded to block shape and the padding is
     hidden behind sentinel segment ids, then the output is sliced back.
+
+    Block sizes resolve once per TRACE through the tuning tier
+    (``tune.cache``): env defaults when tuning is off, the persisted
+    per-bucket winner when on, block 0 (= the XLA lowering) on a miss or
+    a tuned Pallas loss. They ride the custom_vjp as nondiff args so the
+    backward sees the same forward decision.
     """
     if kv_segment_ids is None:
         kv_segment_ids = q_segment_ids
     if q_segment_ids is None:
         q_segment_ids = kv_segment_ids
     if _use_pallas():
-        _, _, ok = _blocks_ok(q, k)
-        tq, tk = q.shape[2], k.shape[2]
-        if not ok and (not causal or tq == tk):
-            # under causal, padding both seqs by the SAME amount preserves
-            # the bottom-right alignment offset (tk - tq); with tq != tk
-            # that cannot be guaranteed, so those rare shapes fall back
-            return _flash_attention_padded(q, k, v, scale, causal,
-                                           q_segment_ids, kv_segment_ids)
+        blocks = _resolve_attention_blocks("flash_fwd", q, k, causal,
+                                           q_segment_ids is not None)
+    else:
+        # no Pallas here: blocks are inert (the reference path runs), so
+        # skip the tuning tier — a CPU process logs no spurious misses
+        blocks = (flash_block_q(), flash_block_k())
+    if blocks is None:
+        bq = bk = 0  # sentinel: XLA lowering
+    else:
+        bq, bk = blocks
+        if _use_pallas():
+            tq, tk = q.shape[2], k.shape[2]
+            ok = _axis_tiles(tq, bq) and _axis_tiles(tk, bk)
+            if not ok and (not causal or tq == tk):
+                # under causal, padding both seqs by the SAME amount
+                # preserves the bottom-right alignment offset (tk - tq);
+                # with tq != tk that cannot be guaranteed, so those rare
+                # shapes fall back
+                return _flash_attention_padded(q, k, v, scale, causal,
+                                               q_segment_ids,
+                                               kv_segment_ids, bq, bk)
     if q_segment_ids is None:
-        return _flash_attention_plain(q, k, v, scale, causal)
+        return _flash_attention_plain(q, k, v, scale, causal, bq, bk)
     return _flash_attention_seg(q, k, v,
                                 q_segment_ids.astype(jnp.int32),
                                 kv_segment_ids.astype(jnp.int32),
-                                scale, causal)
+                                scale, causal, bq, bk)
 
 
 def _block_padded_len(t, block):
     """Next multiple of ``block`` >= t. Reached only when some axis fails
-    to tile, which requires t > 256 on the q axis; the causal branch also
-    evaluates the k rule with t <= 512 (result: one block). Any t <= its
-    own block size tiles trivially because the block clamps to
-    min(block, t)."""
+    to tile; any t <= its own block size tiles trivially because the
+    block clamps to min(block, t)."""
     return -(-t // block) * block
 
 
@@ -280,19 +372,20 @@ def _axis_tiles(t, block):
     return t % min(block, t) == 0
 
 
-def _flash_attention_padded(q, k, v, scale, causal, q_seg, k_seg):
+def _flash_attention_padded(q, k, v, scale, causal, q_seg, k_seg,
+                            block_q, block_k):
     b, _, tq, d = q.shape
     tk = k.shape[2]
     if causal:  # tq == tk here: one common padded length keeps the offset
-        lq = lk = max(_block_padded_len(tq, DEFAULT_BLOCK_Q),
-                      _block_padded_len(tk, DEFAULT_BLOCK_K))
+        lq = lk = max(_block_padded_len(tq, block_q),
+                      _block_padded_len(tk, block_k))
     else:
         # pad only the axes that don't already tile (e.g. non-causal
         # T=384: q needs 512 but k tiles at bk=384 — leave k alone)
-        lq = tq if _axis_tiles(tq, DEFAULT_BLOCK_Q) else \
-            _block_padded_len(tq, DEFAULT_BLOCK_Q)
-        lk = tk if _axis_tiles(tk, DEFAULT_BLOCK_K) else \
-            _block_padded_len(tk, DEFAULT_BLOCK_K)
+        lq = tq if _axis_tiles(tq, block_q) else \
+            _block_padded_len(tq, block_q)
+        lk = tk if _axis_tiles(tk, block_k) else \
+            _block_padded_len(tk, block_k)
 
     def padt(x, length):
         return jnp.pad(x, ((0, 0), (0, 0), (0, length - x.shape[2]),
@@ -305,7 +398,8 @@ def _flash_attention_padded(q, k, v, scale, causal, q_seg, k_seg):
         # off and their zero output-cotangents keep the backward exact —
         # so the cheaper plain kernel runs, with no seg operands
         out = _flash_attention_plain(padt(q, lq), padt(k, lk),
-                                     padt(v, lk), scale, causal)
+                                     padt(v, lk), scale, causal,
+                                     block_q, block_k)
         return out[:, :, :tq]
     if q_seg is None:
         q_seg = jnp.ones((b, tq), jnp.int32)
@@ -318,41 +412,46 @@ def _flash_attention_padded(q, k, v, scale, causal, q_seg, k_seg):
     k_seg = jnp.pad(k_seg.astype(jnp.int32) * 2, ((0, 0), (0, lk - tk)),
                     constant_values=-3)
     out = _flash_attention_seg(padt(q, lq), padt(k, lk), padt(v, lk),
-                               q_seg, k_seg, scale, causal)
+                               q_seg, k_seg, scale, causal,
+                               block_q, block_k)
     return out[:, :, :tq]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention_plain(q, k, v, scale=None, causal=False):
-    return _flash_attention_impl(q, k, v, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_plain(q, k, v, scale, causal, block_q, block_k):
+    return _flash_attention_impl(q, k, v, scale, causal, block_q, block_k)
 
 
-def _blocks_ok(q, k):
-    bq = min(DEFAULT_BLOCK_Q, q.shape[2])
-    bk = min(DEFAULT_BLOCK_K, k.shape[2])
+def _clamped_blocks(q, k, block_q, block_k):
+    """Clamp raw (possibly bucket-sized) blocks to the actual seq axes and
+    check tiling. block 0 is the XLA sentinel — never ok."""
+    if block_q <= 0 or block_k <= 0:
+        return 0, 0, False
+    bq = min(block_q, q.shape[2])
+    bk = min(block_k, k.shape[2])
     ok = q.shape[2] % bq == 0 and k.shape[2] % bk == 0
     return bq, bk, ok
 
 
-def _flash_attention_impl(q, k, v, scale, causal):
+def _flash_attention_impl(q, k, v, scale, causal, block_q, block_k):
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     if not _use_pallas():
         return _attention_reference(q, k, v, s, causal)
     # head_dim needs no padding (Mosaic handles sub-lane widths); the seq
     # axes must tile evenly by the block sizes
-    bq, bk, ok = _blocks_ok(q, k)
+    bq, bk, ok = _clamped_blocks(q, k, block_q, block_k)
     if not ok:
-        # ragged shapes: padded KV rows would need an extra mask; the
-        # reference path is simplest-correct there
+        # XLA sentinel, or ragged shapes where padded KV rows would need
+        # an extra mask: the reference path is simplest-correct
         return _attention_reference(q, k, v, s, causal)
     return _flash_attention_tpu(q, k, v, s, causal, bq, bk)
 
 
-def _flash_fwd(q, k, v, scale, causal):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
-    bq, bk, ok = _blocks_ok(q, k)
+    bq, bk, ok = _clamped_blocks(q, k, block_q, block_k)
     if _use_pallas() and ok:
         out, lse = _flash_attention_tpu(q, k, v, s, causal, bq, bk,
                                         return_lse=True)
@@ -361,23 +460,24 @@ def _flash_fwd(q, k, v, scale, causal):
 
 
 # -- segment-ids (key padding / packed sequences) variant -------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _flash_attention_seg(q, k, v, q_seg, k_seg, scale, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_attention_seg(q, k, v, q_seg, k_seg, scale, causal,
+                         block_q, block_k):
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     if not _use_pallas():
         return _attention_reference(q, k, v, s, causal, q_seg, k_seg)
-    bq, bk, ok = _blocks_ok(q, k)
+    bq, bk, ok = _clamped_blocks(q, k, block_q, block_k)
     if not ok:
         return _attention_reference(q, k, v, s, causal, q_seg, k_seg)
     return _flash_attention_tpu(q, k, v, s, causal, bq, bk,
                                 q_seg=q_seg, k_seg=k_seg)
 
 
-def _flash_seg_fwd(q, k, v, q_seg, k_seg, scale, causal):
+def _flash_seg_fwd(q, k, v, q_seg, k_seg, scale, causal, block_q, block_k):
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
-    bq, bk, ok = _blocks_ok(q, k)
+    bq, bk, ok = _clamped_blocks(q, k, block_q, block_k)
     if _use_pallas() and ok:
         out, lse = _flash_attention_tpu(q, k, v, s, causal, bq, bk,
                                         return_lse=True,
@@ -387,20 +487,23 @@ def _flash_seg_fwd(q, k, v, q_seg, k_seg, scale, causal):
     return out, (q, k, v, q_seg, k_seg, None, None)
 
 
-def _flash_seg_bwd(scale, causal, res, g):
+def _flash_seg_bwd(scale, causal, block_q, block_k, res, g):
     import numpy as onp
 
     q, k, v, q_seg, k_seg, out, lse = res
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     if lse is not None and _use_pallas():
-        bq, bk, ok = _blocks_ok(q, k)
-        if ok:
-            dq, dk, dv = _flash_bwd_tpu(q, k, v, out, lse, g, s, causal,
-                                        bq, bk, q_seg=q_seg, k_seg=k_seg)
-            return (dq, dk, dv,
-                    onp.zeros(q_seg.shape, jax.dtypes.float0),
-                    onp.zeros(k_seg.shape, jax.dtypes.float0))
+        bwd = _resolve_attention_blocks("flash_bwd", q, k, causal, True)
+        if bwd is not None:
+            bq, bk, ok = _clamped_blocks(q, k, *bwd)
+            if ok:
+                dq, dk, dv = _flash_bwd_tpu(q, k, v, out, lse, g, s,
+                                            causal, bq, bk,
+                                            q_seg=q_seg, k_seg=k_seg)
+                return (dq, dk, dv,
+                        onp.zeros(q_seg.shape, jax.dtypes.float0),
+                        onp.zeros(k_seg.shape, jax.dtypes.float0))
     dq, dk, dv = _attention_bwd_blockwise(q, k, v, g, s, causal,
                                           q_seg=q_seg, k_seg=k_seg)
     return (dq, dk, dv,
@@ -738,15 +841,19 @@ def _attention_bwd_blockwise(q, k, v, g, scale, causal, q_seg=None,
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_bwd(scale, causal, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     if lse is not None and _use_pallas():
-        bq = min(DEFAULT_BLOCK_Q, q.shape[2])
-        bk = min(DEFAULT_BLOCK_K, k.shape[2])
-        if q.shape[2] % bq == 0 and k.shape[2] % bk == 0:
-            return _flash_bwd_tpu(q, k, v, out, lse, g, s, causal, bq, bk)
+        # the backward resolves its own tuned config: its grids iterate
+        # the opposite axes from the forward, so the winners differ
+        bwd = _resolve_attention_blocks("flash_bwd", q, k, causal, False)
+        if bwd is not None:
+            bq, bk, ok = _clamped_blocks(q, k, *bwd)
+            if ok:
+                return _flash_bwd_tpu(q, k, v, out, lse, g, s, causal,
+                                      bq, bk)
     return _attention_bwd_blockwise(q, k, v, g, s, causal)
 
 
@@ -766,13 +873,32 @@ def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
                 b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-def fused_layer_norm(x, gamma, beta, eps=1e-5, block_rows=128):
+def _rows_of(shape):
+    rows = 1
+    for sdim in shape[:-1]:
+        rows *= sdim
+    return rows
+
+
+def fused_layer_norm(x, gamma, beta, eps=1e-5, block_rows=None):
     """Row-wise LayerNorm over the last axis (Pallas on TPU, XLA elsewhere).
 
     Differentiable: forward runs the kernel, backward flows through the
     identical XLA formula via jax.custom_vjp below.
+
+    ``block_rows=None`` resolves through the tuning tier per trace
+    (env default 128 when tuning is off; 0 = the XLA lowering on a miss
+    or a tuned Pallas loss); pass an explicit value to pin it.
     """
-    return _fused_ln(x, gamma, beta, eps)
+    if block_rows is None:
+        if _use_pallas() and x.shape[-1] % 128 == 0:
+            block_rows = _resolve_block_rows("layer_norm",
+                                             _rows_of(x.shape),
+                                             x.shape[-1], x.dtype)
+        else:
+            # kernel can't run here anyway — don't log a tuning miss
+            block_rows = 128
+    return _fused_ln(x, gamma, beta, eps, int(block_rows))
 
 
 def _ln_reference(x, gamma, beta, eps):
@@ -784,24 +910,33 @@ def _ln_reference(x, gamma, beta, eps):
     return ((x - mean) * inv * gamma + beta).astype(x.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _fused_ln(x, gamma, beta, eps):
-    if not _use_pallas():
+def _pad_rows(xr, br):
+    """Pad the row axis up to a multiple of ``br`` (zero rows — sliced
+    off after the kernel, so their values never escape)."""
+    rows = xr.shape[0]
+    target = -(-rows // br) * br
+    if target == rows:
+        return xr, rows
+    return jnp.pad(xr, ((0, target - rows), (0, 0))), rows
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_ln(x, gamma, beta, eps, block_rows):
+    if not _use_pallas() or block_rows <= 0:
         return _ln_reference(x, gamma, beta, eps)
     d = x.shape[-1]
     if d % 128 != 0:
+        # the feature axis cannot be padded (it changes the row mean);
+        # non-lane-aligned widths stay on the reference path
         return _ln_reference(x, gamma, beta, eps)
     orig_shape = x.shape
-    rows = 1
-    for sdim in orig_shape[:-1]:
-        rows *= sdim
-    xr = x.reshape(rows, d)
-    br = min(128, rows)
-    if rows % br != 0:
-        return _ln_reference(x, gamma, beta, eps)
+    rows = _rows_of(orig_shape)
+    br = min(block_rows, rows)
+    # ragged row counts stay fused: pad tail rows, slice them back off
+    xr, rows = _pad_rows(x.reshape(rows, d), br)
     out = pl.pallas_call(
         functools.partial(_ln_kernel, eps=eps),
-        grid=(rows // br,),
+        grid=(xr.shape[0] // br,),
         in_specs=[
             pl.BlockSpec((br, d), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
@@ -810,17 +945,17 @@ def _fused_ln(x, gamma, beta, eps):
         ],
         out_specs=pl.BlockSpec((br, d), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((xr.shape[0], d), x.dtype),
         interpret=_interpret(),
     )(xr, gamma, beta)
-    return out.reshape(orig_shape)
+    return out[:rows].reshape(orig_shape)
 
 
-def _fused_ln_fwd(x, gamma, beta, eps):
-    return _fused_ln(x, gamma, beta, eps), (x, gamma, beta)
+def _fused_ln_fwd(x, gamma, beta, eps, block_rows):
+    return _fused_ln(x, gamma, beta, eps, block_rows), (x, gamma, beta)
 
 
-def _fused_ln_bwd(eps, res, g):
+def _fused_ln_bwd(eps, block_rows, res, g):
     x, gamma, beta = res
     _, vjp = jax.vjp(lambda x_, g_, b_: _ln_reference(x_, g_, b_, eps),
                      x, gamma, beta)
@@ -840,43 +975,54 @@ def _softmax_kernel(x_ref, o_ref):
     o_ref[:] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(o_ref.dtype)
 
 
-@jax.custom_vjp
-def fused_softmax(x):
-    return _fused_softmax_impl(x)
+def fused_softmax(x, block_rows=None):
+    """Last-axis softmax (Pallas on TPU, XLA elsewhere) — same gate audit
+    as attention/LayerNorm: ``_use_pallas()`` + lane-aligned width, with
+    ragged row counts padded to the block and sliced back. ``block_rows``
+    resolves through the tuning tier when None.
+    """
+    if block_rows is None:
+        if _use_pallas() and x.shape[-1] % 128 == 0:
+            block_rows = _resolve_block_rows("softmax", _rows_of(x.shape),
+                                             x.shape[-1], x.dtype)
+        else:
+            block_rows = 128
+    return _fused_softmax(x, int(block_rows))
 
 
-def _fused_softmax_impl(x):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _fused_softmax(x, block_rows):
+    return _fused_softmax_impl(x, block_rows)
+
+
+def _fused_softmax_impl(x, block_rows):
     d = x.shape[-1]
-    if not _use_pallas() or d % 128 != 0:
+    if not _use_pallas() or block_rows <= 0 or d % 128 != 0:
         return jax.nn.softmax(x, axis=-1)
-    rows = 1
-    for sdim in x.shape[:-1]:
-        rows *= sdim
-    br = min(128, rows)
-    if rows % br != 0:
-        return jax.nn.softmax(x, axis=-1)
-    xr = x.reshape(rows, d)
+    rows = _rows_of(x.shape)
+    br = min(block_rows, rows)
+    xr, rows = _pad_rows(x.reshape(rows, d), br)
     out = pl.pallas_call(
         _softmax_kernel,
-        grid=(rows // br,),
+        grid=(xr.shape[0] // br,),
         in_specs=[pl.BlockSpec((br, d), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((br, d), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((rows, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((xr.shape[0], d), x.dtype),
         interpret=_interpret(),
     )(xr)
-    return out.reshape(x.shape)
+    return out[:rows].reshape(x.shape)
 
 
-def _fused_softmax_fwd(x):
-    y = _fused_softmax_impl(x)
+def _fused_softmax_fwd(x, block_rows):
+    y = _fused_softmax_impl(x, block_rows)
     return y, y
 
 
-def _fused_softmax_bwd(y, g):
+def _fused_softmax_bwd(block_rows, y, g):
     gy = (g - jnp.sum(g * y, axis=-1, keepdims=True)) * y
     return (gy,)
 
 
-fused_softmax.defvjp(_fused_softmax_fwd, _fused_softmax_bwd)
+_fused_softmax.defvjp(_fused_softmax_fwd, _fused_softmax_bwd)

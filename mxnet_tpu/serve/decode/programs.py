@@ -487,7 +487,12 @@ class DecodePrograms:
     def warmup(self):
         """Compile the whole table: decode_tick_k + every (batch, len)
         prefill (and prefix-join) bucket. After this, serving compiles
-        nothing."""
+        nothing. Tuned kernel configs (``MXTPU_TUNE=1``) preload first so
+        each trace resolves its blocks from the persisted winners — the
+        engine never tunes online."""
+        from ...tune import preload as _tune_preload
+
+        _tune_preload()
         self.ensure("decode")
         for T in self.len_ladder:
             for B in self.batch_ladder:

@@ -1,0 +1,22 @@
+"""mxnet_tpu.tune — autotuned Pallas kernel tier.
+
+Offline: enumerate + measure block configs per (kernel, shape-bucket,
+dtype) with :func:`autotune` (or ``tools/tune_kernels.py`` /
+``bench.py tune``), winners persisted next to the XLA compile cache.
+Online: serving warmup calls :func:`preload`; every kernel trace calls
+:func:`resolve`, which never tunes and never picks a config that lost
+its measurement. See docs/DESIGN.md "Kernel autotuner".
+"""
+from .cache import (bucket, cache_path, enabled, entries, key_attention,
+                    key_rows, missed, override, preload, record, reset,
+                    resolve, save, status, trials)
+from .tuner import (attention_spec, autotune, candidates, ladder_specs,
+                    rows_spec, spec_from_key, spec_key, tune_one)
+
+__all__ = [
+    "enabled", "resolve", "override", "record", "save", "preload",
+    "reset", "missed", "entries", "status", "cache_path", "trials",
+    "bucket", "key_attention", "key_rows",
+    "attention_spec", "rows_spec", "ladder_specs", "spec_key",
+    "spec_from_key", "candidates", "tune_one", "autotune",
+]

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Lint: every ``serve.*`` / ``telemetry.*`` / ``checkpoint.*`` /
-``fault.*`` / ``train.*`` / ``collective.*`` / ``collective_bytes.*``
-metric name created anywhere in ``mxnet_tpu/``
+``fault.*`` / ``train.*`` / ``collective.*`` / ``collective_bytes.*`` /
+``tune.*`` metric name created anywhere in ``mxnet_tpu/``
 must appear in docs/DESIGN.md (the Observability metric inventory), and
 every ``MXTPU_*`` environment variable actually read from the
 environment must appear in docs/ENV_VARS.md — so the exported
@@ -34,7 +34,7 @@ _CREATE = re.compile(
     r"(?:counter|gauge|timer|histogram|Counter|Gauge|Timer|Histogram)\(\s*"
     r"(f?)([\"'])"
     r"((?:serve|telemetry|checkpoint|fault|train|mem|numerics"
-    r"|collective_bytes|collective)"
+    r"|collective_bytes|collective|tune)"
     r"\.[^\"']*)\2")
 
 
@@ -106,8 +106,8 @@ def main():
     missing = missing_names()
     if not missing:
         print(f"metric docs lint: all {len(collect())} "
-              "serve./telemetry./checkpoint./fault./train./mem./numerics. "
-              "names documented in docs/DESIGN.md")
+              "serve./telemetry./checkpoint./fault./train./mem./numerics."
+              "/tune. names documented in docs/DESIGN.md")
     else:
         print("metric names missing from docs/DESIGN.md:", file=sys.stderr)
         for name, sites in sorted(missing.items()):

@@ -84,7 +84,7 @@ for _ in range(20):
     out = fn(q, q, q)
 out.block_until_ready()
 dt = (time.perf_counter() - t0) / 20
-print(json.dumps({"t": T, "bq": pk.DEFAULT_BLOCK_Q, "bk": pk.DEFAULT_BLOCK_K,
+print(json.dumps({"t": T, "bq": pk.flash_block_q(), "bk": pk.flash_block_k(),
                   "ms": round(dt * 1e3, 4)}))
 """
 
